@@ -1,0 +1,16 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch rebuild of early LightGBM's capabilities (histogram-based
+leaf-wise GBDT/DART, binary/regression/multiclass/LambdaRank, bagging,
+feature subsampling, early stopping, model text IO, distributed training)
+designed for TPUs: binned uint8 feature matrices in HBM, fused histogram /
+split-search kernels under jit, and XLA collectives over a device mesh in
+place of socket/MPI allreduce.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config  # noqa: F401
+from .io import BinMapper, BinnedDataset, Metadata  # noqa: F401
+
+__all__ = ["Config", "BinMapper", "BinnedDataset", "Metadata", "__version__"]

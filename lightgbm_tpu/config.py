@@ -1,0 +1,304 @@
+"""Configuration system for the TPU-native GBDT framework.
+
+Re-expresses the reference's layered ``key=value`` config with alias
+normalization (reference: include/LightGBM/config.h:320-410 alias table,
+config.h:91-262 defaults, src/io/config.cpp:35-61 dispatch) as a single
+Python dataclass.  Reference configs (``examples/*/train.conf``) parse
+unchanged via :func:`Config.from_dict` / :func:`parse_config_file`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+# Alias table mirrors reference config.h:320-410 (KeyAliasTransform):
+# an alias never overrides an explicitly-given canonical key.
+PARAM_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "random_seed": "seed",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "metrics": "metric",
+    "metric_types": "metric",
+}
+
+
+def key_alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize alias keys to canonical names (canonical key wins on clash)."""
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for k, v in params.items():
+        canon = PARAM_ALIASES.get(k)
+        if canon is None:
+            out[k] = v
+        else:
+            aliased[canon] = v
+    for k, v in aliased.items():
+        out.setdefault(k, v)
+    return out
+
+
+def _to_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    return str(v).strip().lower() in ("true", "1", "yes", "y", "on", "+")
+
+
+def _to_int_list(v: Any) -> List[int]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(x) for x in str(v).replace(",", " ").split()]
+
+
+def _to_str_list(v: Any) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    return [s for s in str(v).replace(",", " ").split()]
+
+
+@dataclasses.dataclass
+class Config:
+    """All training/prediction parameters with reference defaults.
+
+    Defaults mirror reference config.h:91-262 (max_bin=256, num_leaves=127,
+    learning_rate=0.1, min_data_in_leaf=100, min_sum_hessian_in_leaf=10, ...).
+    """
+
+    # ---- task / IO (IOConfig, config.h:91-135)
+    task: str = "train"
+    data: str = ""
+    valid_data: List[str] = dataclasses.field(default_factory=list)
+    max_bin: int = 256
+    num_class: int = 1
+    data_random_seed: int = 1
+    output_model: str = "LightGBM_model.txt"
+    input_model: str = ""
+    output_result: str = "LightGBM_predict_result.txt"
+    verbose: int = 1
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+    bin_construct_sample_cnt: int = 50000
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    is_predict_raw_score: bool = False
+    is_predict_leaf_index: bool = False
+
+    # ---- objective (ObjectiveConfig, config.h:137-152)
+    objective: str = "regression"
+    sigmoid: float = 1.0
+    label_gain: List[float] = dataclasses.field(default_factory=list)
+    max_position: int = 20
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+
+    # ---- metric (MetricConfig, config.h:154-163)
+    metric: List[str] = dataclasses.field(default_factory=list)
+    metric_freq: int = 1  # a.k.a. output_freq
+    is_training_metric: bool = False
+    ndcg_eval_at: List[int] = dataclasses.field(default_factory=lambda: [1, 2, 3, 4, 5])
+
+    # ---- tree (TreeConfig, config.h:165-190)
+    min_data_in_leaf: int = 100
+    min_sum_hessian_in_leaf: float = 10.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    num_leaves: int = 127
+    feature_fraction_seed: int = 2
+    feature_fraction: float = 1.0
+    max_depth: int = -1
+    top_k: int = 20
+    # TPU extension: tree growth strategy.  "leafwise" reproduces the
+    # reference's best-first growth exactly (serial_tree_learner.cpp:116-150);
+    # "depthwise" grows level-by-level (one fused histogram pass per level,
+    # much faster on TPU) while keeping the num_leaves budget via best-gain
+    # masking at the final level.
+    tree_growth: str = "leafwise"
+
+    # ---- boosting (BoostingConfig, config.h:192-221)
+    boosting_type: str = "gbdt"
+    num_iterations: int = 10
+    learning_rate: float = 0.1
+    bagging_fraction: float = 1.0
+    bagging_seed: int = 3
+    bagging_freq: int = 0
+    early_stopping_round: int = 0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+
+    # ---- tree learner selection (config.cpp:324-335)
+    tree_learner: str = "serial"  # serial | feature | data | voting
+
+    # ---- network (NetworkConfig, config.h:223-231): on TPU the "machines"
+    # are mesh devices; these remain accepted for config compatibility.
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+
+    seed: int = 0
+    num_threads: int = 0
+
+    def __post_init__(self):
+        if not self.metric:
+            self.metric = []
+
+    # -- derived flags (CheckParamConflict, config.cpp:136-175)
+    @property
+    def is_parallel(self) -> bool:
+        return self.tree_learner in ("feature", "data", "voting")
+
+    @property
+    def num_leaves_(self) -> int:
+        return max(2, int(self.num_leaves))
+
+    @classmethod
+    def from_dict(cls, params: Dict[str, Any]) -> "Config":
+        params = key_alias_transform(dict(params))
+        known = {f.name: f for f in dataclasses.fields(cls)}
+        kwargs: Dict[str, Any] = {}
+        for k, v in params.items():
+            if k == "output_freq":
+                k = "metric_freq"
+            if k not in known:
+                continue  # unknown keys are ignored (logged by callers)
+            f = known[k]
+            if f.type in ("int", int):
+                kwargs[k] = int(float(v))
+            elif f.type in ("float", float):
+                kwargs[k] = float(v)
+            elif f.type in ("bool", bool):
+                kwargs[k] = _to_bool(v)
+            elif k in ("valid_data", "metric"):
+                kwargs[k] = _to_str_list(v)
+            elif k == "ndcg_eval_at":
+                kwargs[k] = _to_int_list(v)
+            elif k == "label_gain":
+                kwargs[k] = [float(x) for x in _to_str_list(v)]
+            else:
+                kwargs[k] = str(v)
+        cfg = cls(**kwargs)
+        cfg._check_conflicts()
+        return cfg
+
+    def _check_conflicts(self) -> None:
+        """Mirror CheckParamConflict (config.cpp:136-175)."""
+        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+            raise ValueError(f"Unknown tree_learner: {self.tree_learner!r}")
+        if self.boosting_type == "gbrt":  # accepted synonym (config.cpp:78)
+            self.boosting_type = "gbdt"
+        if self.boosting_type not in ("gbdt", "dart"):
+            raise ValueError(f"Unknown boosting_type: {self.boosting_type!r}")
+        if self.tree_growth not in ("leafwise", "depthwise"):
+            raise ValueError(f"Unknown tree_growth: {self.tree_growth!r}")
+        if self.max_bin < 2:
+            raise ValueError("max_bin must be >= 2")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def parse_line_params(items: Sequence[str]) -> Dict[str, str]:
+    """Parse ``key=value`` tokens (CLI argv / config lines), like Str2Map."""
+    out: Dict[str, str] = {}
+    for item in items:
+        item = item.strip()
+        if not item or item.startswith("#"):
+            continue
+        if "=" in item:
+            k, v = item.split("=", 1)
+            out[k.strip()] = v.split("#", 1)[0].strip()
+    return out
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a reference-style config file (``key = value`` lines, # comments)."""
+    with open(path, "r") as fh:
+        return parse_line_params(fh.readlines())

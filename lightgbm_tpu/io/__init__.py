@@ -1,0 +1,15 @@
+from .binner import BinMapper, find_bin_mappers, NUMERICAL, CATEGORICAL
+from .metadata import Metadata
+from .dataset import BinnedDataset
+from .parser import parse_file, detect_format
+
+__all__ = [
+    "BinMapper",
+    "find_bin_mappers",
+    "NUMERICAL",
+    "CATEGORICAL",
+    "Metadata",
+    "BinnedDataset",
+    "parse_file",
+    "detect_format",
+]

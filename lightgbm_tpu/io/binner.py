@@ -1,0 +1,271 @@
+"""Per-feature value->bin discretization (BinMapper).
+
+Re-expresses the reference bin-finding semantics (src/io/bin.cpp:44-196) in
+vectorized numpy:
+
+* numerical features: if the number of distinct sampled values fits in
+  ``max_bin``, each distinct value gets its own bin with upper bounds at
+  midpoints (bin.cpp:90-99); otherwise greedy equal-frequency binning where
+  values whose sample count exceeds the running mean bin size are forced
+  into their own bin (bin.cpp:100-153).
+* categorical features: categories sorted by descending count, top
+  ``max_bin`` kept, the rest mapped to the most frequent bin's... dropped
+  to bin of their own absence (reference maps unseen to bin 0 at data-push
+  time; bin.cpp:155-186).
+
+Zero values that were elided from the sample (sparse collection) are
+re-inserted with their count, as the reference does (bin.cpp:48-85).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+NUMERICAL = 0
+CATEGORICAL = 1
+
+
+class BinMapper:
+    """Maps raw feature values to integer bins.
+
+    Attributes
+    ----------
+    bin_type: NUMERICAL or CATEGORICAL
+    num_bin: number of bins actually used (<= max_bin)
+    bin_upper_bound: float64[num_bin] upper bound per bin (numerical);
+        last entry is +inf (bin.cpp:99,152)
+    bin_to_category / category_to_bin: categorical mappings (bin.cpp:173-180)
+    is_trivial: single-bin feature, dropped from training (bin.cpp:188-193)
+    """
+
+    __slots__ = (
+        "bin_type",
+        "num_bin",
+        "bin_upper_bound",
+        "bin_to_category",
+        "category_to_bin",
+        "is_trivial",
+        "sparse_rate",
+    )
+
+    def __init__(self):
+        self.bin_type = NUMERICAL
+        self.num_bin = 1
+        self.bin_upper_bound = np.array([np.inf])
+        self.bin_to_category: List[int] = []
+        self.category_to_bin: Dict[int, int] = {}
+        self.is_trivial = True
+        self.sparse_rate = 0.0
+
+    # ------------------------------------------------------------------ find
+    @staticmethod
+    def find(
+        sample_values: np.ndarray,
+        total_sample_cnt: Optional[int] = None,
+        max_bin: int = 256,
+        bin_type: int = NUMERICAL,
+    ) -> "BinMapper":
+        """Learn the discretization from sampled values.
+
+        ``total_sample_cnt`` may exceed ``len(sample_values)``; the gap is
+        treated as elided zeros (bin.cpp:48).  NaNs are treated as zeros
+        (the reference parser never produces NaN; we are more lenient).
+        """
+        m = BinMapper()
+        m.bin_type = bin_type
+        vals = np.asarray(sample_values, dtype=np.float64)
+        vals = vals[~np.isnan(vals)]
+        if total_sample_cnt is None:
+            total_sample_cnt = len(vals)
+        zero_cnt = int(total_sample_cnt - len(vals))
+
+        # distinct values + counts, with elided zeros folded in
+        if len(vals):
+            distinct, counts = np.unique(vals, return_counts=True)
+        else:
+            distinct, counts = np.array([], dtype=np.float64), np.array([], dtype=np.int64)
+        if zero_cnt > 0:
+            zi = np.searchsorted(distinct, 0.0)
+            if zi < len(distinct) and distinct[zi] == 0.0:
+                counts = counts.copy()
+                counts[zi] += zero_cnt
+            else:
+                distinct = np.insert(distinct, zi, 0.0)
+                counts = np.insert(counts, zi, zero_cnt)
+        counts = counts.astype(np.int64)
+        sample_size = int(total_sample_cnt)
+        num_values = len(distinct)
+
+        if num_values == 0:
+            m.num_bin = 1
+            m.bin_upper_bound = np.array([np.inf])
+            m.is_trivial = True
+            return m
+
+        if bin_type == NUMERICAL:
+            if num_values <= max_bin:
+                # one bin per distinct value; midpoint upper bounds
+                m.num_bin = num_values
+                ub = np.empty(num_values, dtype=np.float64)
+                ub[:-1] = (distinct[:-1] + distinct[1:]) / 2.0
+                ub[-1] = np.inf
+                m.bin_upper_bound = ub
+                cnt_in_bin0 = int(counts[0])
+            else:
+                ub, cnt_in_bin0 = _greedy_equal_freq(
+                    distinct, counts, sample_size, max_bin
+                )
+                m.bin_upper_bound = ub
+                m.num_bin = len(ub)
+        else:
+            ivals = distinct.astype(np.int64)
+            # merge duplicate ints (floats truncating to same int)
+            idistinct, inv = np.unique(ivals, return_inverse=True)
+            icounts = np.zeros(len(idistinct), dtype=np.int64)
+            np.add.at(icounts, inv, counts)
+            # sort by count descending, stable on category id for determinism
+            order = np.lexsort((idistinct, -icounts))
+            idistinct, icounts = idistinct[order], icounts[order]
+            m.num_bin = min(max_bin, len(idistinct))
+            kept = idistinct[: m.num_bin]
+            m.bin_to_category = [int(c) for c in kept]
+            m.category_to_bin = {int(c): i for i, c in enumerate(kept)}
+            used_cnt = int(icounts[: m.num_bin].sum())
+            cnt_in_bin0 = sample_size - used_cnt + int(icounts[0])
+
+        m.is_trivial = m.num_bin <= 1
+        m.sparse_rate = cnt_in_bin0 / max(sample_size, 1)
+        return m
+
+    # --------------------------------------------------------------- mapping
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin (reference bin.h:353-375)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == NUMERICAL:
+            # NaN (missing) behaves like 0.0, matching how find() counts it
+            values = np.where(np.isnan(values), 0.0, values)
+            # bin b holds values <= bin_upper_bound[b]; searchsorted left on
+            # upper bounds gives the first bound >= value.
+            bins = np.searchsorted(self.bin_upper_bound, values, side="left")
+            return np.clip(bins, 0, self.num_bin - 1).astype(np.int32)
+        ivals = np.nan_to_num(values, nan=0.0).astype(np.int64)
+        out = np.zeros(len(ivals), dtype=np.int32)
+        # unseen categories -> bin 0 (reference SparseCategoricalBin pushes
+        # only known categories; dense unknown falls to default bin 0)
+        if self.category_to_bin:
+            cats = np.array(self.bin_to_category, dtype=np.int64)
+            sorter = np.argsort(cats)
+            pos = np.searchsorted(cats[sorter], ivals)
+            pos = np.clip(pos, 0, len(cats) - 1)
+            hit = cats[sorter][pos] == ivals
+            out = np.where(hit, sorter[pos], 0).astype(np.int32)
+        return out
+
+    def bin_to_value(self, bins: np.ndarray) -> np.ndarray:
+        """Representative real value per bin, for model text output the
+        reference stores the *upper bound* as the threshold (tree.cpp:70)."""
+        bins = np.asarray(bins, dtype=np.int64)
+        if self.bin_type == NUMERICAL:
+            return self.bin_upper_bound[np.clip(bins, 0, self.num_bin - 1)]
+        arr = np.array(self.bin_to_category, dtype=np.float64)
+        return arr[np.clip(bins, 0, self.num_bin - 1)]
+
+    @property
+    def default_bin(self) -> int:
+        """Bin of the value 0.0 (bin.h:150-160), the implicit bin for
+        sparse/elided entries."""
+        if self.bin_type == NUMERICAL:
+            return int(self.value_to_bin(np.array([0.0]))[0])
+        return int(self.category_to_bin.get(0, 0))
+
+    # --------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        return {
+            "bin_type": int(self.bin_type),
+            "num_bin": int(self.num_bin),
+            "bin_upper_bound": [float(x) for x in np.asarray(self.bin_upper_bound)],
+            "bin_to_category": list(self.bin_to_category),
+            "is_trivial": bool(self.is_trivial),
+            "sparse_rate": float(self.sparse_rate),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        m = BinMapper()
+        m.bin_type = int(d["bin_type"])
+        m.num_bin = int(d["num_bin"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_to_category = [int(c) for c in d.get("bin_to_category", [])]
+        m.category_to_bin = {c: i for i, c in enumerate(m.bin_to_category)}
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d.get("sparse_rate", 0.0))
+        return m
+
+
+def _greedy_equal_freq(
+    distinct: np.ndarray, counts: np.ndarray, sample_size: int, max_bin: int
+):
+    """Greedy equal-frequency binning with big-count isolation
+    (bin.cpp:100-153).
+
+    Values with count >= mean bin size get their own bin; remaining values
+    are packed left-to-right until the running mean bin size is reached.
+    Returns (bin_upper_bound, cnt_in_bin0).
+    """
+    num_values = len(distinct)
+    mean_bin_size = sample_size / float(max_bin)
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = int(sample_size - counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / float(max(rest_bin_cnt, 1))
+
+    upper_bounds: List[float] = []
+    lower_bounds: List[float] = [float(distinct[0])]
+    cnt_in_bin0 = 0
+    cur_cnt_inbin = 0
+    bin_cnt = 0
+    for i in range(num_values - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur_cnt_inbin += int(counts[i])
+        # close the current bin? (bin.cpp:127-128)
+        if (
+            is_big[i]
+            or cur_cnt_inbin >= mean_bin_size
+            or (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))
+        ):
+            upper_bounds.append(float(distinct[i]))
+            if bin_cnt == 0:
+                cnt_in_bin0 = cur_cnt_inbin
+            bin_cnt += 1
+            lower_bounds.append(float(distinct[i + 1]))
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt_inbin = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / float(max(rest_bin_cnt, 1))
+    bin_cnt += 1
+    ub = np.empty(bin_cnt, dtype=np.float64)
+    for i in range(bin_cnt - 1):
+        ub[i] = (upper_bounds[i] + lower_bounds[i + 1]) / 2.0
+    ub[bin_cnt - 1] = np.inf
+    return ub, cnt_in_bin0
+
+
+def find_bin_mappers(
+    sample: np.ndarray,
+    total_sample_cnt: Optional[int] = None,
+    max_bin: int = 256,
+    categorical_features: Sequence[int] = (),
+) -> List[BinMapper]:
+    """Find a BinMapper per column of a sampled row-matrix ``sample``."""
+    cats = set(int(c) for c in categorical_features)
+    mappers = []
+    n = sample.shape[0] if total_sample_cnt is None else total_sample_cnt
+    for j in range(sample.shape[1]):
+        bt = CATEGORICAL if j in cats else NUMERICAL
+        mappers.append(BinMapper.find(sample[:, j], n, max_bin, bt))
+    return mappers

@@ -1,0 +1,332 @@
+"""Binned dataset: the device-friendly column store.
+
+TPU-native redesign of the reference ``Dataset``/``DatasetLoader``
+(include/LightGBM/dataset.h:279-411, src/io/dataset_loader.cpp): instead of
+per-feature Bin objects (dense u8/u16/u32 + sparse delta encodings), the
+whole dataset is a single dense binned matrix ``X_bin: uint8[n, F]`` (u16
+when any feature has >256 bins) laid out row-major in host memory and moved
+to TPU HBM once.  Trivial (single-bin) features are dropped and tracked via
+``used_feature_map`` exactly like the reference (dataset.h:286-307).
+
+Loading pipeline (mirrors DatasetLoader::LoadFromFile, dataset_loader.cpp:162):
+parse text -> resolve column roles -> sample rows (bin_construct_sample_cnt)
+-> find per-feature BinMappers -> encode all rows to bins.  Valid sets are
+encoded with the *train* set's mappers (LoadFromFileAlignWithOtherDataset,
+dataset_loader.cpp:223-264).  A binary cache (npz) skips parse+binning
+(SaveBinaryFile/LoadFromBinFile, dataset.cpp:131-168).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from .binner import BinMapper, CATEGORICAL, NUMERICAL, find_bin_mappers
+from .metadata import Metadata
+from .parser import parse_file
+
+BINARY_MAGIC = "lightgbm_tpu_binned_dataset_v1"
+
+
+def _resolve_column(spec: str, names: Optional[List[str]]) -> Optional[int]:
+    """Resolve 'name:foo' or integer-string column spec to an index
+    (dataset_loader.cpp:23-160)."""
+    if spec is None or spec == "":
+        return None
+    if spec.startswith("name:"):
+        if names is None:
+            raise ValueError("column given by name but data has no header")
+        return names.index(spec[5:])
+    return int(spec)
+
+
+def _resolve_column_list(spec: str, names: Optional[List[str]]) -> List[int]:
+    if not spec:
+        return []
+    if spec.startswith("name:"):
+        if names is None:
+            raise ValueError("columns given by name but data has no header")
+        return [names.index(s) for s in spec[5:].split(",")]
+    return [int(s) for s in spec.replace(",", " ").split()]
+
+
+class BinnedDataset:
+    """Columns binned to integers + metadata; ready for device transfer."""
+
+    def __init__(
+        self,
+        X_bin: np.ndarray,
+        bin_mappers: List[BinMapper],
+        used_feature_map: np.ndarray,
+        num_total_features: int,
+        metadata: Metadata,
+        feature_names: Optional[List[str]] = None,
+    ):
+        assert X_bin.ndim == 2 and X_bin.shape[1] == len(bin_mappers)
+        self.X_bin = X_bin  # [n, F_used] uint8/uint16
+        self.bin_mappers = bin_mappers  # per *used* feature
+        # used_feature_map[orig_col] = inner feature idx or -1 (dataset.h:286)
+        self.used_feature_map = used_feature_map
+        self.num_total_features = int(num_total_features)
+        self.metadata = metadata
+        self.feature_names = feature_names or [
+            f"Column_{i}" for i in range(num_total_features)
+        ]
+
+    # ---------------------------------------------------------------- props
+    @property
+    def num_data(self) -> int:
+        return self.X_bin.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.X_bin.shape[1]
+
+    @property
+    def num_bins_per_feature(self) -> np.ndarray:
+        return np.array([m.num_bin for m in self.bin_mappers], dtype=np.int32)
+
+    @property
+    def max_num_bin(self) -> int:
+        return int(self.num_bins_per_feature.max()) if self.num_features else 1
+
+    @property
+    def is_categorical(self) -> np.ndarray:
+        return np.array(
+            [m.bin_type == CATEGORICAL for m in self.bin_mappers], dtype=bool
+        )
+
+    def inner_to_real_feature(self, inner: int) -> int:
+        """Inner feature index -> original column index."""
+        return int(np.nonzero(self.used_feature_map == inner)[0][0])
+
+    @property
+    def real_feature_indices(self) -> np.ndarray:
+        out = np.full(self.num_features, -1, dtype=np.int64)
+        for orig, inner in enumerate(self.used_feature_map):
+            if inner >= 0:
+                out[inner] = orig
+        return out
+
+    # ------------------------------------------------------------ construct
+    @staticmethod
+    def from_matrix(
+        X: np.ndarray,
+        metadata: Metadata,
+        config: Optional[Config] = None,
+        bin_mappers: Optional[List[BinMapper]] = None,
+        categorical_features: Sequence[int] = (),
+        feature_names: Optional[List[str]] = None,
+    ) -> "BinnedDataset":
+        """Bin a dense feature matrix.  When ``bin_mappers`` is given the
+        dataset is aligned to them (valid-set path)."""
+        config = config or Config()
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n, f_total = X.shape
+        if bin_mappers is None:
+            # sample rows for bin finding (config.h:108 default 50k)
+            cnt = min(n, int(config.bin_construct_sample_cnt))
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_idx = (
+                np.arange(n)
+                if cnt >= n
+                else np.sort(rng.choice(n, size=cnt, replace=False))
+            )
+            mappers_all = find_bin_mappers(
+                X[sample_idx],
+                total_sample_cnt=len(sample_idx),
+                max_bin=config.max_bin,
+                categorical_features=categorical_features,
+            )
+        else:
+            mappers_all = None
+
+        if mappers_all is not None:
+            used_map = np.full(f_total, -1, dtype=np.int64)
+            used_mappers: List[BinMapper] = []
+            for j, m in enumerate(mappers_all):
+                if not m.is_trivial:
+                    used_map[j] = len(used_mappers)
+                    used_mappers.append(m)
+        else:
+            # align to given mappers: caller passes used_feature_map too via
+            # align_with(); here assume mappers correspond to all columns used
+            raise ValueError("use align_with() for pre-binned mappers")
+
+        dtype = np.uint8 if max((m.num_bin for m in used_mappers), default=1) <= 256 else np.uint16
+        X_bin = np.empty((n, len(used_mappers)), dtype=dtype)
+        for orig, inner in enumerate(used_map):
+            if inner >= 0:
+                X_bin[:, inner] = used_mappers[inner].value_to_bin(X[:, orig])
+        return BinnedDataset(
+            X_bin, used_mappers, used_map, f_total, metadata, feature_names
+        )
+
+    def align_with(
+        self, X: np.ndarray, metadata: Metadata
+    ) -> "BinnedDataset":
+        """Bin another raw matrix with THIS dataset's mappers (valid set
+        alignment, dataset_loader.cpp:223-264)."""
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n, f_total = X.shape
+        if f_total < self.num_total_features:
+            pad = np.zeros((n, self.num_total_features - f_total), dtype=np.float64)
+            X = np.hstack([X, pad])
+        X_bin = np.empty((n, self.num_features), dtype=self.X_bin.dtype)
+        for orig, inner in enumerate(self.used_feature_map):
+            if inner >= 0:
+                X_bin[:, inner] = self.bin_mappers[inner].value_to_bin(X[:, orig])
+        return BinnedDataset(
+            X_bin,
+            self.bin_mappers,
+            self.used_feature_map,
+            self.num_total_features,
+            metadata,
+            self.feature_names,
+        )
+
+    @staticmethod
+    def from_file(
+        path: str,
+        config: Optional[Config] = None,
+        reference: Optional["BinnedDataset"] = None,
+    ) -> "BinnedDataset":
+        """Load + bin a text data file (or its binary cache)."""
+        config = config or Config()
+        bin_path = path + ".bin"
+        if os.path.exists(bin_path) and reference is None:
+            try:
+                return BinnedDataset.load_binary(bin_path)
+            except Exception:
+                pass
+        raw, names = parse_file(path, has_header=config.has_header)
+        label_col = _resolve_column(config.label_column, names)
+        if label_col is None:
+            label_col = 0
+        ignore = set(_resolve_column_list(config.ignore_column, names))
+        cats = _resolve_column_list(config.categorical_column, names)
+
+        n = raw.shape[0]
+        label = raw[:, label_col].astype(np.float32)
+        side = Metadata.load_side_files(path)
+        weight_col = _resolve_column(config.weight_column, names)
+        group_col = _resolve_column(config.group_column, names)
+        weights = side.get("weights")
+        if weight_col is not None:
+            weights = raw[:, weight_col].astype(np.float32)
+            ignore.add(weight_col)
+        qb = side.get("query_boundaries")
+        if group_col is not None:
+            gid = raw[:, group_col].astype(np.int64)
+            # contiguous group ids -> boundaries
+            change = np.nonzero(np.diff(gid))[0] + 1
+            qb = np.concatenate([[0], change, [n]])
+            ignore.add(group_col)
+
+        feat_cols = [
+            j for j in range(raw.shape[1]) if j != label_col and j not in ignore
+        ]
+        X = raw[:, feat_cols]
+        fnames = (
+            [names[j] for j in feat_cols]
+            if names is not None
+            else [f"Column_{j}" for j in range(len(feat_cols))]
+        )
+        cat_inner = [feat_cols.index(c) for c in cats if c in feat_cols]
+        meta = Metadata(
+            label=label,
+            weights=weights,
+            query_boundaries=qb,
+            init_score=side.get("init_score"),
+        )
+        if reference is not None:
+            return reference.align_with(X, meta)
+        ds = BinnedDataset.from_matrix(
+            X, meta, config, categorical_features=cat_inner, feature_names=fnames
+        )
+        if config.is_save_binary_file:
+            ds.save_binary(bin_path)
+        return ds
+
+    # ---------------------------------------------------------- binary cache
+    def save_binary(self, path: str) -> None:
+        import json
+
+        tmp = path + ".tmp.npz"
+        np.savez_compressed(
+            tmp,
+            magic=BINARY_MAGIC,
+            X_bin=self.X_bin,
+            used_feature_map=self.used_feature_map,
+            num_total_features=self.num_total_features,
+            mappers=json.dumps([m.to_dict() for m in self.bin_mappers]),
+            feature_names=json.dumps(self.feature_names),
+            label=self.metadata.label if self.metadata.label is not None else np.empty(0),
+            weights=self.metadata.weights
+            if self.metadata.weights is not None
+            else np.empty(0),
+            query_boundaries=self.metadata.query_boundaries
+            if self.metadata.query_boundaries is not None
+            else np.empty(0, dtype=np.int64),
+            init_score=self.metadata.init_score
+            if self.metadata.init_score is not None
+            else np.empty(0),
+        )
+        # numpy appends .npz to names without it; move atomically onto the
+        # requested name so a re-save never leaves a stale cache behind
+        os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
+
+    @staticmethod
+    def load_binary(path: str) -> "BinnedDataset":
+        import json
+
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["magic"]) != BINARY_MAGIC:
+                raise ValueError("not a lightgbm_tpu binary dataset file")
+            mappers = [BinMapper.from_dict(d) for d in json.loads(str(z["mappers"]))]
+            meta = Metadata(
+                label=z["label"] if z["label"].size else None,
+                weights=z["weights"] if z["weights"].size else None,
+                query_boundaries=z["query_boundaries"]
+                if z["query_boundaries"].size
+                else None,
+                init_score=z["init_score"] if z["init_score"].size else None,
+            )
+            return BinnedDataset(
+                z["X_bin"],
+                mappers,
+                z["used_feature_map"],
+                int(z["num_total_features"]),
+                meta,
+                json.loads(str(z["feature_names"])),
+            )
+
+    # -------------------------------------------------------------- numerics
+    def subset(self, indices: np.ndarray) -> "BinnedDataset":
+        """Row subset sharing bin mappers (Dataset::Subset, dataset.cpp:59)."""
+        indices = np.asarray(indices)
+        return BinnedDataset(
+            self.X_bin[indices],
+            self.bin_mappers,
+            self.used_feature_map,
+            self.num_total_features,
+            self.metadata.subset(indices),
+            self.feature_names,
+        )
+
+    def check_align(self, other: "BinnedDataset") -> bool:
+        """Valid-data bin compatibility (Dataset::CheckAlign,
+        dataset.h:290-306)."""
+        if other.num_features != self.num_features:
+            return False
+        return all(
+            a.num_bin == b.num_bin for a, b in zip(self.bin_mappers, other.bin_mappers)
+        )
+
+    def bin_thresholds_real(self) -> List[np.ndarray]:
+        """Per-feature real-valued threshold for each bin (used when writing
+        tree thresholds in raw-value space, tree.cpp:70)."""
+        return [m.bin_upper_bound if m.bin_type == NUMERICAL else np.asarray(m.bin_to_category, dtype=np.float64) for m in self.bin_mappers]

@@ -1,0 +1,130 @@
+"""Text data parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Mirrors the reference's behavior (src/io/parser.cpp): the format is sniffed
+from delimiter statistics of the first non-empty lines (parser.cpp:72-144);
+LibSVM is detected by ``idx:value`` pairs.  Parsing itself is vectorized via
+numpy/pandas rather than the reference's char-by-char Atof loops.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def detect_format(sample_lines: List[str]) -> str:
+    """Return one of 'csv', 'tsv', 'libsvm' (parser.cpp:72-144)."""
+    for line in sample_lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.replace("\t", " ").replace(",", " ").split()
+        colon_tokens = [t for t in tokens[1:] if ":" in t]
+        if colon_tokens and all(":" in t for t in tokens[1:]):
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+        return "tsv"  # space-separated treated as tsv-style whitespace
+    return "csv"
+
+
+def _read_head(path: str, n: int = 2) -> List[str]:
+    lines = []
+    with open(path, "r") as fh:
+        for _ in range(n):
+            line = fh.readline()
+            if not line:
+                break
+            lines.append(line)
+    return lines
+
+
+def parse_file(
+    path: str,
+    has_header: bool = False,
+    fmt: Optional[str] = None,
+) -> Tuple[np.ndarray, Optional[List[str]]]:
+    """Parse a data file into a dense float64 row-matrix.
+
+    Returns (matrix including the label column if present, header names or
+    None).  Column-role resolution (which column is the label etc.) is the
+    caller's job, mirroring DatasetLoader (dataset_loader.cpp:23-160).
+    """
+    head = _read_head(path, 2 if not has_header else 3)
+    if fmt is None:
+        fmt = detect_format(head[1:] if has_header else head)
+    if fmt == "libsvm":
+        with open(path, "r") as fh:
+            if has_header:
+                fh.readline()
+            return _parse_libsvm(fh), None
+
+    import pandas as pd
+
+    # true tab-separated files keep pandas' fast C engine; arbitrary
+    # whitespace needs the python engine's regex separator
+    probe = head[-1] if head else ""
+    if fmt == "csv":
+        sep, engine = ",", "c"
+    elif "\t" in probe:
+        sep, engine = "\t", "c"
+    else:
+        sep, engine = r"\s+", "python"
+    df = pd.read_csv(
+        path,
+        sep=sep,
+        header=0 if has_header else None,
+        engine=engine,
+        dtype=np.float64,
+        na_values=["", "NA", "nan", "NaN"],
+    )
+    names = [str(c) for c in df.columns] if has_header else None
+    return df.to_numpy(dtype=np.float64), names
+
+
+def _parse_libsvm(lines) -> np.ndarray:
+    """LibSVM ``label idx:val ...`` lines -> dense matrix (column 0 = label).
+
+    ``lines`` is any iterable of strings (an open file, a list, ...)."""
+    labels: List[float] = []
+    rows: List[Tuple[np.ndarray, np.ndarray]] = []
+    max_idx = -1
+    for line in lines:
+        parts = line.split()
+        if not parts:
+            continue
+        labels.append(float(parts[0]))
+        if len(parts) > 1:
+            kv = np.array([p.split(":") for p in parts[1:]])
+            idx = kv[:, 0].astype(np.int64)
+            val = kv[:, 1].astype(np.float64)
+        else:
+            idx = np.empty(0, dtype=np.int64)
+            val = np.empty(0, dtype=np.float64)
+        if len(idx):
+            max_idx = max(max_idx, int(idx.max()))
+        rows.append((idx, val))
+    n, f = len(labels), max_idx + 1
+    out = np.zeros((n, f + 1), dtype=np.float64)
+    out[:, 0] = labels
+    for i, (idx, val) in enumerate(rows):
+        out[i, idx + 1] = val
+    return out
+
+
+def parse_lines(lines: List[str], fmt: Optional[str] = None) -> np.ndarray:
+    """Parse in-memory text lines (used by the Predictor file path)."""
+    if fmt is None:
+        fmt = detect_format(lines[:2])
+    if fmt == "libsvm":
+        return _parse_libsvm(lines)
+    import pandas as pd
+
+    buf = io.StringIO("".join(l if l.endswith("\n") else l + "\n" for l in lines))
+    sep = "," if fmt == "csv" else r"\s+"
+    df = pd.read_csv(buf, sep=sep, header=None, engine="python", dtype=np.float64)
+    return df.to_numpy(dtype=np.float64)

@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU platform so the
+multi-device (mesh) code paths run without TPU hardware."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+REFERENCE_DIR = "/root/reference"
+
+
+@pytest.fixture(scope="session")
+def reference_examples():
+    """Path to the reference's bundled example datasets (skip if absent)."""
+    path = os.path.join(REFERENCE_DIR, "examples")
+    if not os.path.isdir(path):
+        pytest.skip("reference examples not available")
+    return path

@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binner import BinMapper, NUMERICAL, CATEGORICAL
+
+
+def test_distinct_values_fit_in_max_bin():
+    # few distinct values -> one bin per value, midpoint bounds
+    vals = np.array([1.0, 2.0, 2.0, 3.0, 1.0, 3.0, 3.0])
+    m = BinMapper.find(vals, max_bin=256)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound[:-1], [1.5, 2.5])
+    assert m.bin_upper_bound[-1] == np.inf
+    assert not m.is_trivial
+    # mapping: value <= upper bound
+    bins = m.value_to_bin(np.array([0.5, 1.0, 1.6, 2.0, 2.51, 99.0]))
+    np.testing.assert_array_equal(bins, [0, 0, 1, 1, 2, 2])
+
+
+def test_trivial_feature():
+    m = BinMapper.find(np.full(10, 7.0), max_bin=256)
+    assert m.num_bin == 1
+    assert m.is_trivial
+
+
+def test_elided_zeros_are_counted():
+    # sample holds only non-zeros; total_sample_cnt implies 5 zeros
+    vals = np.array([1.0, 2.0])
+    m = BinMapper.find(vals, total_sample_cnt=7, max_bin=256)
+    assert m.num_bin == 3  # 0, 1, 2
+    assert m.value_to_bin(np.array([0.0]))[0] == 0
+    assert m.default_bin == 0
+
+
+def test_greedy_equal_frequency_binning():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m = BinMapper.find(vals, max_bin=16)
+    assert m.num_bin <= 16
+    assert m.num_bin >= 14  # roughly equal-frequency
+    bins = m.value_to_bin(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # equal-frequency: no empty bins, roughly balanced
+    assert counts.min() > 0
+    assert counts.max() < 3 * 10000 / 16
+
+
+def test_big_count_value_gets_own_bin():
+    # a value holding >1/max_bin of mass must isolate into its own bin
+    vals = np.concatenate([np.zeros(5000), np.linspace(1, 2, 5000)])
+    m = BinMapper.find(vals, max_bin=8)
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    # bin of zero contains only zeros
+    other = m.value_to_bin(np.array([1.0]))[0]
+    assert other != zb
+
+
+def test_monotone_mapping():
+    rng = np.random.RandomState(1)
+    vals = rng.exponential(size=5000)
+    m = BinMapper.find(vals, max_bin=32)
+    xs = np.sort(rng.exponential(size=100))
+    bins = m.value_to_bin(xs)
+    assert np.all(np.diff(bins) >= 0)
+
+
+def test_categorical_binning():
+    vals = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 20 + [9.0] * 5)
+    m = BinMapper.find(vals, max_bin=3, bin_type=CATEGORICAL)
+    assert m.bin_type == CATEGORICAL
+    assert m.num_bin == 3
+    # sorted by count desc: 3, 7, 1 kept; 9 dropped -> bin 0
+    assert m.bin_to_category == [3, 7, 1]
+    np.testing.assert_array_equal(
+        m.value_to_bin(np.array([3.0, 7.0, 1.0, 9.0])), [0, 1, 2, 0]
+    )
+
+
+def test_serialization_roundtrip():
+    vals = np.random.RandomState(2).randn(1000)
+    m = BinMapper.find(vals, max_bin=64)
+    m2 = BinMapper.from_dict(m.to_dict())
+    np.testing.assert_array_equal(
+        m.value_to_bin(vals), m2.value_to_bin(vals)
+    )
+
+
+def test_nan_maps_to_zero_bin():
+    m = BinMapper.find(np.array([-1.0, 0.0, 1.0, 2.0]), max_bin=8)
+    assert (
+        m.value_to_bin(np.array([np.nan]))[0] == m.value_to_bin(np.array([0.0]))[0]
+    )

@@ -1,0 +1,69 @@
+import pytest
+
+from lightgbm_tpu.config import Config, key_alias_transform, parse_line_params
+
+
+def test_defaults_match_reference():
+    c = Config()
+    # reference config.h:91-262
+    assert c.max_bin == 256
+    assert c.num_leaves == 127
+    assert c.learning_rate == 0.1
+    assert c.min_data_in_leaf == 100
+    assert c.min_sum_hessian_in_leaf == 10.0
+    assert c.top_k == 20
+    assert c.num_iterations == 10
+    assert c.bagging_freq == 0
+    assert c.tree_learner == "serial"
+
+
+def test_alias_transform():
+    p = key_alias_transform({"num_tree": "50", "lr": 1, "sub_row": "0.5"})
+    assert p["num_iterations"] == "50"
+    assert p["bagging_fraction"] == "0.5"
+    # canonical key wins over alias
+    p = key_alias_transform({"num_iterations": "10", "num_tree": "99"})
+    assert p["num_iterations"] == "10"
+
+
+def test_from_dict_types():
+    c = Config.from_dict(
+        {
+            "num_trees": "25",
+            "shrinkage_rate": "0.2",
+            "is_training_metric": "true",
+            "metric": "binary_logloss,auc",
+            "ndcg_at": "1,3,5",
+            "application": "binary",
+        }
+    )
+    assert c.num_iterations == 25
+    assert c.learning_rate == 0.2
+    assert c.is_training_metric is True
+    assert c.metric == ["binary_logloss", "auc"]
+    assert c.ndcg_eval_at == [1, 3, 5]
+    assert c.objective == "binary"
+
+
+def test_parse_line_params():
+    p = parse_line_params(["task=train", "# comment", "data = foo.txt # trailing"])
+    assert p == {"task": "train", "data": "foo.txt"}
+
+
+def test_reference_example_conf_parses(reference_examples):
+    from lightgbm_tpu.config import parse_config_file
+
+    p = parse_config_file(
+        f"{reference_examples}/binary_classification/train.conf"
+    )
+    c = Config.from_dict(p)
+    assert c.objective == "binary"
+    assert c.task == "train"
+    assert c.num_leaves > 0
+
+
+def test_invalid_config_rejected():
+    with pytest.raises(ValueError):
+        Config.from_dict({"tree_learner": "bogus"})
+    with pytest.raises(ValueError):
+        Config.from_dict({"boosting_type": "bogus"})

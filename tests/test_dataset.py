@@ -1,0 +1,107 @@
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io import BinnedDataset, Metadata
+
+
+def _toy(n=500, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    X[:, 2] = 1.0  # trivial feature
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_from_matrix_drops_trivial():
+    X, y = _toy()
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), Config(max_bin=32))
+    assert ds.num_total_features == 5
+    assert ds.num_features == 4  # trivial column dropped
+    assert ds.used_feature_map[2] == -1
+    assert ds.X_bin.dtype == np.uint8
+    assert ds.X_bin.shape == (500, 4)
+    assert ds.max_num_bin <= 32
+
+
+def test_align_valid_set():
+    X, y = _toy()
+    Xv, yv = _toy(seed=1)
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), Config(max_bin=32))
+    vs = ds.align_with(Xv, Metadata(label=yv))
+    assert ds.check_align(vs)
+    # same value in both sets gets the same bin
+    probe = np.zeros((1, 5))
+    b1 = ds.bin_mappers[0].value_to_bin(probe[:, 0])
+    b2 = vs.bin_mappers[0].value_to_bin(probe[:, 0])
+    assert b1 == b2
+
+
+def test_binary_cache_roundtrip(tmp_path):
+    X, y = _toy()
+    w = np.abs(np.random.RandomState(3).randn(500)).astype(np.float32)
+    ds = BinnedDataset.from_matrix(
+        X, Metadata(label=y, weights=w), Config(max_bin=32)
+    )
+    p = str(tmp_path / "cache.bin")
+    ds.save_binary(p)
+    ds2 = BinnedDataset.load_binary(p)
+    np.testing.assert_array_equal(ds.X_bin, ds2.X_bin)
+    np.testing.assert_array_equal(ds.metadata.label, ds2.metadata.label)
+    np.testing.assert_array_equal(ds.metadata.weights, ds2.metadata.weights)
+    assert ds.check_align(ds2)
+
+
+def test_subset():
+    X, y = _toy()
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), Config(max_bin=32))
+    idx = np.arange(0, 500, 2)
+    sub = ds.subset(idx)
+    assert sub.num_data == 250
+    np.testing.assert_array_equal(sub.X_bin, ds.X_bin[idx])
+    np.testing.assert_array_equal(sub.metadata.label, y[idx])
+
+
+def test_load_reference_binary_example(reference_examples):
+    cfg = Config.from_dict({"data": "binary.train"})
+    path = os.path.join(reference_examples, "binary_classification", "binary.train")
+    ds = BinnedDataset.from_file(path, cfg)
+    assert ds.num_data == 7000
+    assert ds.num_total_features == 28
+    # weights side file is auto-loaded
+    assert ds.metadata.weights is not None
+    assert len(ds.metadata.weights) == 7000
+    assert set(np.unique(ds.metadata.label)) <= {0.0, 1.0}
+
+
+def test_load_lambdarank_query_file(reference_examples):
+    cfg = Config()
+    path = os.path.join(reference_examples, "lambdarank", "rank.train")
+    ds = BinnedDataset.from_file(path, cfg)
+    assert ds.metadata.query_boundaries is not None
+    assert ds.metadata.query_boundaries[-1] == ds.num_data
+
+
+def test_metadata_group_sizes_to_boundaries():
+    m = Metadata(label=np.zeros(10, np.float32))
+    m.set_field("group", np.array([4, 6]))
+    np.testing.assert_array_equal(m.query_boundaries, [0, 4, 10])
+
+
+def test_binary_cache_overwrite_not_stale(tmp_path):
+    p = str(tmp_path / "c.bin")
+    X = np.random.RandomState(0).randn(50, 3)
+    ds1 = BinnedDataset.from_matrix(X, Metadata(label=np.zeros(50, np.float32)), Config(max_bin=8))
+    ds1.save_binary(p)
+    X2 = np.random.RandomState(1).randn(80, 3)
+    ds2 = BinnedDataset.from_matrix(X2, Metadata(label=np.ones(80, np.float32)), Config(max_bin=8))
+    ds2.save_binary(p)
+    assert BinnedDataset.load_binary(p).num_data == 80
+
+
+def test_metadata_subset_remaps_queries():
+    m = Metadata(label=np.zeros(10, np.float32), query_boundaries=np.array([0, 4, 7, 10]))
+    sub = m.subset(np.array([0, 1, 5, 6, 8]))
+    np.testing.assert_array_equal(sub.query_boundaries, [0, 2, 4, 5])
