@@ -1,0 +1,3 @@
+from .serial import grow_tree, TreeLearnerParams
+
+__all__ = ["grow_tree", "TreeLearnerParams"]

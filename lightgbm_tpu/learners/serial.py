@@ -1,0 +1,256 @@
+"""Serial (single-device) leaf-wise tree learner, fully jittable.
+
+TPU-native re-design of SerialTreeLearner
+(src/treelearner/serial_tree_learner.cpp:116-150): the same best-first
+growth — repeatedly split the leaf with the globally best gain until the
+``num_leaves`` budget or no positive gain remains — expressed as a
+fixed-shape ``lax.fori_loop``:
+
+* per split step, only the SMALLER child's histogram is built from data
+  (one masked scatter pass over all rows); the larger child is parent -
+  smaller (the Subtract trick, feature_histogram.hpp:97-106 and
+  serial_tree_learner.cpp:259-281).  Histograms for every live leaf stay
+  resident in HBM (``hists[L, F, B, 3]``) — the LRU HistogramPool
+  (feature_histogram.hpp:337-481) is unnecessary at TPU memory sizes.
+* the leaf partition is an int32 ``leaf_id`` row vector updated by a
+  vectorized compare (replaces DataPartition::Split, data_partition.hpp:91).
+  Left child keeps the parent's leaf index, right child gets the next
+  fresh index — the reference's exact leaf numbering (tree.cpp:78-89),
+  so trees are comparable node-for-node.
+* the heavy branch runs under ``lax.cond`` so exhausted trees cost
+  nothing per remaining step.
+
+The data-parallel learner wraps this same step with psum'd histograms
+(learners/data_parallel.py); determinism of argmax tie-breaks keeps
+parallel == serial trees (split_info.hpp:98-103 semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.tree import Tree, empty_tree
+from ..ops.histogram import histogram_feature_major
+from ..ops.split import SplitResult, find_best_split, K_MIN_SCORE
+
+
+class TreeLearnerParams(NamedTuple):
+    """Scalar tree-growth constraints (TreeConfig, config.h:165-190)."""
+
+    min_data_in_leaf: jax.Array
+    min_sum_hessian_in_leaf: jax.Array
+    lambda_l1: jax.Array
+    lambda_l2: jax.Array
+    min_gain_to_split: jax.Array
+    max_depth: jax.Array  # <= 0 means unlimited
+
+    @staticmethod
+    def from_config(cfg) -> "TreeLearnerParams":
+        return TreeLearnerParams(
+            min_data_in_leaf=jnp.float32(cfg.min_data_in_leaf),
+            min_sum_hessian_in_leaf=jnp.float32(cfg.min_sum_hessian_in_leaf),
+            lambda_l1=jnp.float32(cfg.lambda_l1),
+            lambda_l2=jnp.float32(cfg.lambda_l2),
+            min_gain_to_split=jnp.float32(cfg.min_gain_to_split),
+            max_depth=jnp.int32(cfg.max_depth),
+        )
+
+
+class _GrowState(NamedTuple):
+    leaf_id: jax.Array  # [n]
+    hists: jax.Array  # [L, F, B, 3]
+    sum_g: jax.Array  # [L]
+    sum_h: jax.Array  # [L]
+    cnt: jax.Array  # [L]
+    best: SplitResult  # arrays of [L]
+    tree: Tree
+
+
+def _empty_best(L: int) -> SplitResult:
+    z = jnp.zeros(L, jnp.float32)
+    return SplitResult(
+        gain=jnp.full(L, K_MIN_SCORE, jnp.float32),
+        feature=jnp.full(L, -1, jnp.int32),
+        threshold=jnp.zeros(L, jnp.int32),
+        left_sum_grad=z,
+        left_sum_hess=z,
+        left_count=z,
+        right_sum_grad=z,
+        right_sum_hess=z,
+        right_count=z,
+        left_output=z,
+        right_output=z,
+    )
+
+
+def _set_best(best: SplitResult, i, new: SplitResult) -> SplitResult:
+    return SplitResult(*[b.at[i].set(n) for b, n in zip(best, new)])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "max_leaves", "hist_fn"),
+)
+def grow_tree(
+    bins_T: jax.Array,  # [F, n] feature-major binned matrix
+    grad: jax.Array,  # [n]
+    hess: jax.Array,  # [n]
+    bag_mask: jax.Array,  # [n] 0/1 bagging mask
+    feature_mask: jax.Array,  # [F] bool, feature_fraction sample
+    num_bins_per_feature: jax.Array,  # [F] int32
+    is_categorical: jax.Array,  # [F] bool
+    params: TreeLearnerParams,
+    num_bins: int,
+    max_leaves: int,
+    hist_fn=None,
+) -> Tuple[Tree, jax.Array]:
+    """Grow one tree; returns (tree, final leaf_id per row).
+
+    ``hist_fn(bins_T, grad, hess, mask) -> [F, B, 3]`` abstracts histogram
+    construction so the data-parallel learner can psum across the mesh;
+    default is the local kernel.
+    """
+    F, n = bins_T.shape
+    L = max_leaves
+
+    if hist_fn is None:
+        hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
+
+    def best_for(hist, sg, sh, c, depth_child):
+        can = (params.max_depth <= 0) | (depth_child < params.max_depth)
+        return find_best_split(
+            hist,
+            sg,
+            sh,
+            c,
+            feature_mask,
+            num_bins_per_feature,
+            is_categorical,
+            params.min_data_in_leaf,
+            params.min_sum_hessian_in_leaf,
+            params.lambda_l1,
+            params.lambda_l2,
+            params.min_gain_to_split,
+            can,
+        )
+
+    # ---- root (BeforeTrain / LeafSplits::Init, leaf_splits.hpp:51-92)
+    hist0 = hist_fn(bins_T, grad, hess, bag_mask)
+    sum_g0 = jnp.sum(grad * bag_mask)
+    sum_h0 = jnp.sum(hess * bag_mask)
+    cnt0 = jnp.sum(bag_mask)
+
+    state = _GrowState(
+        leaf_id=jnp.zeros(n, jnp.int32),
+        hists=jnp.zeros((L, F, num_bins, 3), jnp.float32).at[0].set(hist0),
+        sum_g=jnp.zeros(L, jnp.float32).at[0].set(sum_g0),
+        sum_h=jnp.zeros(L, jnp.float32).at[0].set(sum_h0),
+        cnt=jnp.zeros(L, jnp.float32).at[0].set(cnt0),
+        best=_set_best(
+            _empty_best(L), 0, best_for(hist0, sum_g0, sum_h0, cnt0, jnp.int32(0))
+        ),
+        tree=empty_tree(L),
+    )
+
+    def split_branch(args):
+        state, step, best_leaf = args
+        t = state.tree
+        node = step
+        new_leaf = step + 1
+
+        f = state.best.feature[best_leaf]
+        thr = state.best.threshold[best_leaf]
+        is_cat = is_categorical[f]
+
+        # ---- partition (DataPartition::Split, data_partition.hpp:91-139)
+        vals = bins_T[f].astype(jnp.int32)
+        go_left = jnp.where(is_cat, vals == thr, vals <= thr)
+        in_leaf = state.leaf_id == best_leaf
+        leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
+
+        lsg = state.best.left_sum_grad[best_leaf]
+        lsh = state.best.left_sum_hess[best_leaf]
+        lc = state.best.left_count[best_leaf]
+        rsg = state.best.right_sum_grad[best_leaf]
+        rsh = state.best.right_sum_hess[best_leaf]
+        rc = state.best.right_count[best_leaf]
+
+        # ---- smaller-child histogram from data; sibling by subtraction
+        smaller_is_left = lc <= rc
+        target = jnp.where(smaller_is_left, best_leaf, new_leaf)
+        mask_small = bag_mask * (leaf_id == target)
+        h_small = hist_fn(bins_T, grad, hess, mask_small)
+        h_parent = state.hists[best_leaf]
+        h_large = h_parent - h_small
+        h_left = jnp.where(smaller_is_left, h_small, h_large)
+        h_right = jnp.where(smaller_is_left, h_large, h_small)
+        hists = state.hists.at[best_leaf].set(h_left).at[new_leaf].set(h_right)
+
+        # ---- tree bookkeeping (Tree::Split, tree.cpp:52-96)
+        parent = t.leaf_parent[best_leaf]
+        has_parent = parent >= 0
+        pidx = jnp.maximum(parent, 0)
+        was_left = t.left_child[pidx] == ~best_leaf
+        left_child = t.left_child.at[pidx].set(
+            jnp.where(has_parent & was_left, node, t.left_child[pidx])
+        )
+        right_child = t.right_child.at[pidx].set(
+            jnp.where(has_parent & ~was_left, node, t.right_child[pidx])
+        )
+        left_child = left_child.at[node].set(~best_leaf)
+        right_child = right_child.at[node].set(~new_leaf)
+
+        depth_child = t.leaf_depth[best_leaf] + 1
+        tree = t._replace(
+            num_leaves=t.num_leaves + 1,
+            split_feature=t.split_feature.at[node].set(f),
+            threshold_bin=t.threshold_bin.at[node].set(thr),
+            decision_type=t.decision_type.at[node].set(is_cat.astype(jnp.int32)),
+            left_child=left_child,
+            right_child=right_child,
+            split_gain=t.split_gain.at[node].set(state.best.gain[best_leaf]),
+            internal_value=t.internal_value.at[node].set(t.leaf_value[best_leaf]),
+            internal_count=t.internal_count.at[node].set(lc + rc),
+            leaf_value=t.leaf_value.at[best_leaf]
+            .set(state.best.left_output[best_leaf])
+            .at[new_leaf]
+            .set(state.best.right_output[best_leaf]),
+            leaf_count=t.leaf_count.at[best_leaf].set(lc).at[new_leaf].set(rc),
+            leaf_parent=t.leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node),
+            leaf_depth=t.leaf_depth.at[best_leaf]
+            .set(depth_child)
+            .at[new_leaf]
+            .set(depth_child),
+        )
+
+        # ---- child best splits (FindBestThresholds on the two new leaves)
+        best_l = best_for(h_left, lsg, lsh, lc, depth_child)
+        best_r = best_for(h_right, rsg, rsh, rc, depth_child)
+        best = _set_best(_set_best(state.best, best_leaf, best_l), new_leaf, best_r)
+
+        return _GrowState(
+            leaf_id=leaf_id,
+            hists=hists,
+            sum_g=state.sum_g.at[best_leaf].set(lsg).at[new_leaf].set(rsg),
+            sum_h=state.sum_h.at[best_leaf].set(lsh).at[new_leaf].set(rsh),
+            cnt=state.cnt.at[best_leaf].set(lc).at[new_leaf].set(rc),
+            best=best,
+            tree=tree,
+        )
+
+    def body(step, state):
+        best_leaf = jnp.argmax(state.best.gain).astype(jnp.int32)
+        do_split = state.best.gain[best_leaf] > 0.0
+        return jax.lax.cond(
+            do_split,
+            split_branch,
+            lambda args: args[0],
+            (state, jnp.int32(step), best_leaf),
+        )
+
+    state = jax.lax.fori_loop(0, L - 1, body, state)
+    return state.tree, state.leaf_id

@@ -1,0 +1,19 @@
+from .tree import (
+    Tree,
+    empty_tree,
+    predict_binned,
+    predict_leaf_binned,
+    predict_leaf_raw,
+    predict_raw,
+    finalize_thresholds,
+)
+
+__all__ = [
+    "Tree",
+    "empty_tree",
+    "predict_binned",
+    "predict_leaf_binned",
+    "predict_leaf_raw",
+    "predict_raw",
+    "finalize_thresholds",
+]

@@ -1,0 +1,175 @@
+"""Decision tree model as a fixed-shape array pytree.
+
+The reference's flat-array ``Tree`` (include/LightGBM/tree.h:18-198,
+src/io/tree.cpp) is already array-oriented; we keep its exact layout —
+internal nodes 0..L-2, leaves addressed as ``~leaf`` in child pointers
+(tree.cpp:78-79) — but store every field as a fixed-size jax array so a
+whole ensemble stacks into one pytree and prediction is a vectorized
+gather loop instead of per-row pointer chasing (tree.h:226-238).
+
+``num_leaves`` is the *used* leaf count; arrays are padded to the
+``max_leaves`` training budget so shapes stay static under jit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Tree(NamedTuple):
+    num_leaves: jax.Array  # scalar int32: used leaves (1 = stump)
+    # internal nodes [max_leaves-1]
+    split_feature: jax.Array  # inner feature index
+    split_feature_real: jax.Array  # original column index (model IO)
+    threshold_bin: jax.Array  # bin-space threshold
+    threshold_real: jax.Array  # raw-value threshold (filled at finalize)
+    decision_type: jax.Array  # 0 numerical (<=), 1 categorical (==)
+    left_child: jax.Array  # node idx or ~leaf
+    right_child: jax.Array
+    split_gain: jax.Array
+    internal_value: jax.Array
+    internal_count: jax.Array
+    # leaves [max_leaves]
+    leaf_value: jax.Array
+    leaf_count: jax.Array
+    leaf_parent: jax.Array
+    leaf_depth: jax.Array
+
+    @property
+    def max_leaves(self) -> int:
+        return self.leaf_value.shape[-1]
+
+    def shrink(self, rate) -> "Tree":
+        """Tree::Shrinkage (tree.h:103-107): scale outputs in place."""
+        return self._replace(
+            leaf_value=self.leaf_value * rate,
+            internal_value=self.internal_value * rate,
+        )
+
+
+def empty_tree(max_leaves: int) -> Tree:
+    li = max_leaves - 1
+    return Tree(
+        num_leaves=jnp.int32(1),
+        split_feature=jnp.full(li, -1, jnp.int32),
+        split_feature_real=jnp.full(li, -1, jnp.int32),
+        threshold_bin=jnp.zeros(li, jnp.int32),
+        threshold_real=jnp.zeros(li, jnp.float32),
+        decision_type=jnp.zeros(li, jnp.int32),
+        left_child=jnp.zeros(li, jnp.int32),
+        right_child=jnp.zeros(li, jnp.int32),
+        split_gain=jnp.zeros(li, jnp.float32),
+        internal_value=jnp.zeros(li, jnp.float32),
+        internal_count=jnp.zeros(li, jnp.float32),
+        leaf_value=jnp.zeros(max_leaves, jnp.float32),
+        leaf_count=jnp.zeros(max_leaves, jnp.float32),
+        leaf_parent=jnp.full(max_leaves, -1, jnp.int32),
+        leaf_depth=jnp.zeros(max_leaves, jnp.int32),
+    )
+
+
+@jax.jit
+def predict_leaf_binned(tree: Tree, X_bin: jax.Array) -> jax.Array:
+    """Vectorized root-to-leaf walk over BINNED features -> leaf index.
+
+    Equivalent to Tree::GetLeaf over bin iterators (tree.cpp:98-122).
+    All rows walk in lockstep for at most max_leaves-1 steps; rows that
+    reached a leaf stop updating (their node stays negative).
+    """
+    n = X_bin.shape[0]
+    max_steps = tree.leaf_value.shape[-1] - 1
+
+    # node >= 0: internal; node < 0: ~leaf
+    start = jnp.where(tree.num_leaves > 1, 0, ~0)
+    node = jnp.full((n,), start, jnp.int32)
+
+    def body(state):
+        node, _ = state
+        active = node >= 0
+        idx = jnp.maximum(node, 0)
+        f = tree.split_feature[idx]
+        t = tree.threshold_bin[idx]
+        is_cat = tree.decision_type[idx] == 1
+        v = jnp.take_along_axis(
+            X_bin, f[:, None].astype(jnp.int32), axis=1
+        )[:, 0].astype(jnp.int32)
+        go_left = jnp.where(is_cat, v == t, v <= t)
+        nxt = jnp.where(go_left, tree.left_child[idx], tree.right_child[idx])
+        node = jnp.where(active, nxt, node)
+        return node, jnp.any(node >= 0)
+
+    def cond(state):
+        return state[1]
+
+    node, _ = jax.lax.while_loop(cond, body, (node, tree.num_leaves > 1))
+    return ~node  # leaf index
+
+
+@jax.jit
+def predict_binned(tree: Tree, X_bin: jax.Array) -> jax.Array:
+    """Per-row tree output on binned features."""
+    leaves = predict_leaf_binned(tree, X_bin)
+    return tree.leaf_value[leaves]
+
+
+@jax.jit
+def predict_leaf_raw(tree: Tree, X: jax.Array) -> jax.Array:
+    """Root-to-leaf walk over RAW feature values (Tree::Predict,
+    tree.h:226-238): numerical goes left when value <= threshold_real,
+    categorical when int(value) == threshold_real."""
+    n = X.shape[0]
+    start = jnp.where(tree.num_leaves > 1, 0, ~0)
+    node = jnp.full((n,), start, jnp.int32)
+
+    def body(state):
+        node, _ = state
+        active = node >= 0
+        idx = jnp.maximum(node, 0)
+        f = tree.split_feature_real[idx]
+        t = tree.threshold_real[idx]
+        is_cat = tree.decision_type[idx] == 1
+        v = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        go_left = jnp.where(is_cat, v.astype(jnp.int32) == t.astype(jnp.int32), v <= t)
+        nxt = jnp.where(go_left, tree.left_child[idx], tree.right_child[idx])
+        node = jnp.where(active, nxt, node)
+        return node, jnp.any(node >= 0)
+
+    node, _ = jax.lax.while_loop(lambda s: s[1], body, (node, tree.num_leaves > 1))
+    return ~node
+
+
+@jax.jit
+def predict_raw(tree: Tree, X: jax.Array) -> jax.Array:
+    return tree.leaf_value[predict_leaf_raw(tree, X)]
+
+
+# ---------------------------------------------------------------- host side
+def finalize_thresholds(tree: Tree, bin_thresholds: list, real_feature_indices: np.ndarray) -> Tree:
+    """Fill threshold_real / split_feature_real from bin mappers (host-side,
+    once per built tree).  For numerical features the real threshold is the
+    bin's upper bound (matching how the reference stores thresholds for raw
+    prediction, serial_tree_learner.cpp Split -> BinToValue); categorical
+    thresholds are the category id."""
+    sf = np.asarray(tree.split_feature)
+    tb = np.asarray(tree.threshold_bin)
+    nl = int(tree.num_leaves)
+    tr = np.zeros_like(np.asarray(tree.threshold_real))
+    sfr = np.full_like(sf, -1)
+    for i in range(nl - 1):
+        f = int(sf[i])
+        if f >= 0:
+            bounds = bin_thresholds[f]
+            b = min(int(tb[i]), len(bounds) - 1)
+            v = bounds[b]
+            # +inf upper bound (last bin) can't be a numerical threshold;
+            # it never appears because t <= num_bin-2 for numerical splits
+            tr[i] = np.float32(v if np.isfinite(v) else np.finfo(np.float32).max)
+            sfr[i] = real_feature_indices[f]
+    return tree._replace(
+        threshold_real=jnp.asarray(tr), split_feature_real=jnp.asarray(sfr)
+    )

@@ -1,0 +1,9 @@
+from .histogram import histogram_feature_major, histogram_by_leaf
+from .split import find_best_split, SplitResult
+
+__all__ = [
+    "histogram_feature_major",
+    "histogram_by_leaf",
+    "find_best_split",
+    "SplitResult",
+]

@@ -1,0 +1,155 @@
+"""Vectorized best-split search over feature histograms.
+
+Replaces the reference's per-feature threshold scans
+(FeatureHistogram::FindBestThresholdForNumerical,
+src/treelearner/feature_histogram.hpp:116-181, and
+FindBestThresholdForCategorical, feature_histogram.hpp:187-246) with one
+masked reduction over the whole [F, B] candidate grid:
+
+* numerical: right-side sums via reverse cumulative sums over the bin
+  axis; left = leaf totals - right (exactly the reference's accumulation
+  order, including the kEpsilon seed on the right hessian).
+* categorical: one-vs-rest — "left" is the single bin == threshold.
+* gain/leaf-output formulas with L1/L2 regularization mirror
+  GetLeafSplitGain / CalculateSplittedLeafOutput
+  (feature_histogram.hpp:290-313).
+* determinism: flattening feature-major and taking the FIRST argmax
+  reproduces the reference tie-breaks (smaller threshold within a
+  feature via its strict-improvement right-to-left scan; smaller feature
+  index across features via SplitInfo::operator>, split_info.hpp:98-103).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -jnp.inf
+
+
+class SplitResult(NamedTuple):
+    """Scalar split decision for one leaf (SplitInfo, split_info.hpp:17-44)."""
+
+    gain: jax.Array  # improvement over the un-split leaf (minus gain_shift)
+    feature: jax.Array  # inner feature index (int32), -1 if no split
+    threshold: jax.Array  # bin threshold (int32); left is bin <= t (== for cat)
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+    right_sum_grad: jax.Array
+    right_sum_hess: jax.Array
+    right_count: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
+def _leaf_split_gain(sum_grad, sum_hess, l1, l2):
+    """GetLeafSplitGain (feature_histogram.hpp:290-298)."""
+    reg = jnp.maximum(jnp.abs(sum_grad) - l1, 0.0)
+    return reg * reg / (sum_hess + l2)
+
+
+def _leaf_output(sum_grad, sum_hess, l1, l2):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:306-313)."""
+    reg = jnp.maximum(jnp.abs(sum_grad) - l1, 0.0)
+    return -jnp.sign(sum_grad) * reg / (sum_hess + l2)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def find_best_split(
+    hist: jax.Array,  # [F, B, 3] (sum_grad, sum_hess, count) for one leaf
+    sum_grad: jax.Array,  # scalar leaf totals (bookkept, not re-summed)
+    sum_hess: jax.Array,
+    num_data: jax.Array,  # scalar bagged row count in leaf
+    feature_mask: jax.Array,  # [F] bool: usable this tree (feature_fraction)
+    num_bins_per_feature: jax.Array,  # [F] int32
+    is_categorical: jax.Array,  # [F] bool
+    min_data_in_leaf: jax.Array,
+    min_sum_hessian_in_leaf: jax.Array,
+    lambda_l1: jax.Array,
+    lambda_l2: jax.Array,
+    min_gain_to_split: jax.Array,
+    can_split: jax.Array,  # scalar bool (depth / leaf-size gating)
+) -> SplitResult:
+    F, B, _ = hist.shape
+    hg, hh, hc = hist[..., 0], hist[..., 1], hist[..., 2]
+    bins = jnp.arange(B, dtype=jnp.int32)
+
+    # ---- right-side sums for numerical threshold t: bins > t
+    # reverse cumsum: rsum[t] = sum_{b >= t+1} h[b]
+    def rev_tail(x):  # [F, B] -> tail sums excluding bin t itself
+        c = jnp.cumsum(x[:, ::-1], axis=1)[:, ::-1]  # inclusive suffix sums
+        return jnp.concatenate([c[:, 1:], jnp.zeros((F, 1), x.dtype)], axis=1)
+
+    num_right_g = rev_tail(hg)
+    num_right_h = rev_tail(hh) + K_EPSILON  # matches kEpsilon seed (l.123)
+    num_right_c = rev_tail(hc)
+
+    # ---- categorical one-vs-rest: "left" = the single bin t
+    cat_left_g, cat_left_h, cat_left_c = hg, hh, hc
+
+    is_cat = is_categorical[:, None]
+    left_g = jnp.where(is_cat, cat_left_g, sum_grad - num_right_g)
+    left_h = jnp.where(is_cat, cat_left_h, sum_hess - num_right_h)
+    left_c = jnp.where(is_cat, cat_left_c, num_data - num_right_c)
+    right_g = jnp.where(is_cat, sum_grad - cat_left_g, num_right_g)
+    right_h = jnp.where(is_cat, sum_hess - cat_left_h, num_right_h)
+    right_c = jnp.where(is_cat, num_data - cat_left_c, num_right_c)
+
+    # ---- validity (feature_histogram.hpp:133-142, 199-208)
+    nb = num_bins_per_feature[:, None]
+    in_range = jnp.where(is_cat, bins[None, :] < nb, bins[None, :] < nb - 1)
+    valid = (
+        in_range
+        & feature_mask[:, None]
+        & (left_c >= min_data_in_leaf)
+        & (right_c >= min_data_in_leaf)
+        & (left_h >= min_sum_hessian_in_leaf)
+        & (right_h >= min_sum_hessian_in_leaf)
+    )
+
+    gain_shift = _leaf_split_gain(sum_grad, sum_hess, lambda_l1, lambda_l2)
+    min_gain_shift = gain_shift + min_gain_to_split
+    gains = _leaf_split_gain(left_g, left_h, lambda_l1, lambda_l2) + _leaf_split_gain(
+        right_g, right_h, lambda_l1, lambda_l2
+    )
+    valid = valid & (gains >= min_gain_shift) & can_split
+    gains = jnp.where(valid, gains, K_MIN_SCORE)
+
+    flat = gains.reshape(-1)
+    best = jnp.argmax(flat)  # first max: smaller feature, then smaller bin
+    best_gain_raw = flat[best]
+    feat = (best // B).astype(jnp.int32)
+    thr = (best % B).astype(jnp.int32)
+    splittable = best_gain_raw > K_MIN_SCORE
+
+    lg = left_g[feat, thr]
+    lh = left_h[feat, thr]
+    lc = left_c[feat, thr]
+    rg = right_g[feat, thr]
+    rh = right_h[feat, thr]
+    rc = right_c[feat, thr]
+    return SplitResult(
+        gain=jnp.where(splittable, best_gain_raw - gain_shift, K_MIN_SCORE),
+        feature=jnp.where(splittable, feat, -1),
+        threshold=jnp.where(splittable, thr, 0),
+        left_sum_grad=lg,
+        left_sum_hess=lh,
+        left_count=lc,
+        right_sum_grad=rg,
+        right_sum_hess=rh,
+        right_count=rc,
+        left_output=_leaf_output(lg, lh, lambda_l1, lambda_l2),
+        right_output=_leaf_output(rg, rh, lambda_l1, lambda_l2),
+    )
+
+
+# vectorized over leaves (depthwise grower / batched candidate evaluation)
+find_best_split_leaves = jax.vmap(
+    find_best_split,
+    in_axes=(0, 0, 0, 0, None, None, None, None, None, None, None, None, 0),
+)
