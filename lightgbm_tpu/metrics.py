@@ -1,0 +1,186 @@
+"""Evaluation metrics (src/metric/*.hpp re-expressed, host-side numpy).
+
+All metrics expose ``eval(scores) -> float`` plus ``bigger_is_better``
+(factor_to_bigger_better, metric.h:31) which drives early-stopping
+direction.  Scores are raw (pre-transform) model outputs, class-major
+[K, n] for multiclass — the transforms (sigmoid/softmax) are applied
+inside the metric exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+_EPS = 1e-15
+
+
+class Metric:
+    name = "none"
+    bigger_is_better = False
+
+    def init(self, metadata, num_data: int) -> None:
+        self.label = np.asarray(metadata.label, np.float64)
+        self.weights = (
+            None if metadata.weights is None else np.asarray(metadata.weights, np.float64)
+        )
+        self.sum_weights = (
+            float(num_data) if self.weights is None else float(self.weights.sum())
+        )
+        self.num_data = num_data
+        self.metadata = metadata
+
+    def _avg(self, loss: np.ndarray) -> float:
+        if self.weights is not None:
+            return float((loss * self.weights).sum() / self.sum_weights)
+        return float(loss.sum() / self.sum_weights)
+
+    def eval(self, scores: np.ndarray) -> float:
+        raise NotImplementedError
+
+
+class L2Metric(Metric):
+    """Reports RMSE (AverageLoss takes sqrt, regression_metric.hpp:98-101)."""
+
+    name = "l2"
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        return float(np.sqrt(self._avg((scores - self.label) ** 2)))
+
+
+class L1Metric(Metric):
+    name = "l1"
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        return self._avg(np.abs(scores - self.label))
+
+
+class BinaryLoglossMetric(Metric):
+    """prob = sigmoid(2*sig*score); loss = -log p_y
+    (binary_metric.hpp:44-98)."""
+
+    name = "binary_logloss"
+
+    def __init__(self, config):
+        self.sigmoid = float(config.sigmoid)
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        prob = 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * scores))
+        prob = np.clip(prob, _EPS, 1.0 - _EPS)
+        loss = np.where(self.label > 0, -np.log(prob), -np.log(1.0 - prob))
+        return self._avg(loss)
+
+
+class BinaryErrorMetric(Metric):
+    """Misclassification rate at prob 0.5 (binary_metric.hpp:105-140)."""
+
+    name = "binary_error"
+
+    def __init__(self, config):
+        self.sigmoid = float(config.sigmoid)
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        pred_pos = scores > 0
+        err = (pred_pos != (self.label > 0)).astype(np.float64)
+        return self._avg(err)
+
+
+class AUCMetric(Metric):
+    """Weighted ROC AUC via a single sort sweep with tie handling
+    (binary_metric.hpp:181-238)."""
+
+    name = "auc"
+    bigger_is_better = True
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        w = self.weights if self.weights is not None else np.ones_like(self.label)
+        pos = (self.label > 0).astype(np.float64) * w
+        neg = (self.label <= 0).astype(np.float64) * w
+        order = np.argsort(-scores, kind="mergesort")
+        s, p, ng = scores[order], pos[order], neg[order]
+        # group ties: average rank treatment == trapezoid on grouped counts
+        boundaries = np.nonzero(np.diff(s))[0]
+        group_id = np.zeros(len(s), np.int64)
+        group_id[1:] = np.cumsum(np.diff(s) != 0)
+        npos = np.bincount(group_id, weights=p)
+        nneg = np.bincount(group_id, weights=ng)
+        cum_neg_before = np.concatenate([[0.0], np.cumsum(nneg)[:-1]])
+        # each positive beats all negatives ranked below; ties count half
+        auc_sum = (npos * (cum_neg_before + nneg * 0.5)).sum()
+        total_pos, total_neg = npos.sum(), nneg.sum()
+        if total_pos == 0 or total_neg == 0:
+            return 1.0
+        return float(1.0 - auc_sum / (total_pos * total_neg))
+
+
+class MultiLoglossMetric(Metric):
+    """Softmax logloss (multiclass_metric.hpp)."""
+
+    name = "multi_logloss"
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64)  # [K, n]
+        z = scores - scores.max(axis=0, keepdims=True)
+        logp = z - np.log(np.exp(z).sum(axis=0, keepdims=True))
+        idx = self.label.astype(np.int64)
+        loss = -logp[idx, np.arange(scores.shape[1])]
+        return self._avg(loss)
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, scores):
+        scores = np.asarray(scores, np.float64)
+        pred = scores.argmax(axis=0)
+        err = (pred != self.label.astype(np.int64)).astype(np.float64)
+        return self._avg(err)
+
+
+def create_metrics(config, metadata=None, num_data: Optional[int] = None) -> List[Metric]:
+    """Factory (metric.cpp:9-28); unknown names raise."""
+    out: List[Metric] = []
+    names = config.metric or _default_metric(config.objective)
+    for name in names:
+        name = name.strip()
+        if name in ("l2", "mse", "mean_squared_error", "regression"):
+            m: Metric = L2Metric()
+        elif name in ("l1", "mae", "mean_absolute_error"):
+            m = L1Metric()
+        elif name == "binary_logloss":
+            m = BinaryLoglossMetric(config)
+        elif name == "binary_error":
+            m = BinaryErrorMetric(config)
+        elif name == "auc":
+            m = AUCMetric()
+        elif name == "multi_logloss":
+            m = MultiLoglossMetric()
+        elif name == "multi_error":
+            m = MultiErrorMetric()
+        elif name in ("ndcg", "ndcg@"):
+            from .metrics_rank import NDCGMetric
+
+            m = NDCGMetric(config)
+        elif name in ("", "none", "null"):
+            continue
+        else:
+            raise ValueError(f"Unknown metric: {name!r}")
+        if metadata is not None:
+            m.init(metadata, num_data if num_data is not None else len(metadata.label))
+        out.append(m)
+    return out
+
+
+def _default_metric(objective: str) -> List[str]:
+    return {
+        "regression": ["l2"],
+        "binary": ["binary_logloss"],
+        "multiclass": ["multi_logloss"],
+        "lambdarank": ["ndcg"],
+    }.get(objective, ["l2"])

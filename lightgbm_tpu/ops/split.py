@@ -13,10 +13,13 @@ masked reduction over the whole [F, B] candidate grid:
 * gain/leaf-output formulas with L1/L2 regularization mirror
   GetLeafSplitGain / CalculateSplittedLeafOutput
   (feature_histogram.hpp:290-313).
-* determinism: flattening feature-major and taking the FIRST argmax
-  reproduces the reference tie-breaks (smaller threshold within a
-  feature via its strict-improvement right-to-left scan; smaller feature
-  index across features via SplitInfo::operator>, split_info.hpp:98-103).
+* determinism: the reference scans thresholds HIGH->LOW with strict
+  improvement (feature_histogram.hpp:129,154), so equal-gain ties keep
+  the LARGEST threshold within a feature; across features the smaller
+  feature index wins (SplitInfo::operator>, split_info.hpp:98-103).  We
+  reproduce this by argmax-ing over (feature asc, bin desc) order.
+  Matters for raw-space routing when bins between tied thresholds are
+  empty — verified against the reference binary on binary.train.
 """
 
 from __future__ import annotations
@@ -120,11 +123,13 @@ def find_best_split(
     valid = valid & (gains >= min_gain_shift) & can_split
     gains = jnp.where(valid, gains, K_MIN_SCORE)
 
-    flat = gains.reshape(-1)
-    best = jnp.argmax(flat)  # first max: smaller feature, then smaller bin
+    # argmax over (feature asc, bin desc): reverse the bin axis so the
+    # first maximum is the smallest feature with the LARGEST threshold
+    flat = gains[:, ::-1].reshape(-1)
+    best = jnp.argmax(flat)
     best_gain_raw = flat[best]
     feat = (best // B).astype(jnp.int32)
-    thr = (best % B).astype(jnp.int32)
+    thr = (B - 1 - best % B).astype(jnp.int32)
     splittable = best_gain_raw > K_MIN_SCORE
 
     lg = left_g[feat, thr]

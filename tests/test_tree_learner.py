@@ -80,7 +80,9 @@ def oracle_grow(bins, grad, hess, bag, max_leaves, nb, is_cat=None,
             hg = np.bincount(b, weights=grad[rows], minlength=nb)
             hh = np.bincount(b, weights=hess[rows], minlength=nb)
             hc = np.bincount(b, minlength=nb)
-            trange = range(nb) if is_cat[f] else range(nb - 1)
+            # reference scans thresholds high->low with strict improvement,
+            # so equal-gain ties keep the LARGEST threshold
+            trange = range(nb - 1, -1, -1) if is_cat[f] else range(nb - 2, -1, -1)
             for t in trange:
                 if is_cat[f]:
                     lgr, lh, lc = hg[t], hh[t], hc[t]
