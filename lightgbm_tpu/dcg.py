@@ -1,0 +1,46 @@
+"""DCG/NDCG shared machinery (DCGCalculator, src/metric/dcg_calculator.cpp).
+
+Default label gains 2^i - 1 and position discounts 1/log2(2+i)
+(dcg_calculator.cpp:13-32, kMaxPosition=10000).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+K_MAX_POSITION = 10000
+_MAX_LABEL = 31
+
+
+def default_label_gains() -> np.ndarray:
+    return (2.0 ** np.arange(_MAX_LABEL) - 1.0).astype(np.float64)
+
+
+def label_gains_from_config(label_gain: Sequence[float]) -> np.ndarray:
+    if label_gain:
+        return np.asarray(label_gain, np.float64)
+    return default_label_gains()
+
+
+def position_discounts(n: int) -> np.ndarray:
+    """discount[i] = 1 / log2(2 + i) (dcg_calculator.cpp:25-28)."""
+    return 1.0 / np.log2(2.0 + np.arange(n, dtype=np.float64))
+
+
+def max_dcg_at_k(k: int, labels: np.ndarray, gains: np.ndarray) -> float:
+    """CalMaxDCGAtK (dcg_calculator.cpp:34-56): ideal DCG using labels
+    sorted descending."""
+    labels = np.asarray(labels)
+    k = min(int(k), len(labels))
+    top = np.sort(labels.astype(np.int64))[::-1][:k]
+    disc = position_discounts(k)
+    return float((gains[top] * disc).sum())
+
+
+def dcg_at_k(k: int, labels_in_score_order: np.ndarray, gains: np.ndarray) -> float:
+    labels = np.asarray(labels_in_score_order).astype(np.int64)
+    k = min(int(k), len(labels))
+    disc = position_discounts(k)
+    return float((gains[labels[:k]] * disc).sum())

@@ -1,0 +1,62 @@
+"""NDCG@k metric (src/metric/rank_metric.hpp:16-165)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .dcg import dcg_at_k, label_gains_from_config, max_dcg_at_k
+from .metrics import Metric
+
+
+class NDCGMetric(Metric):
+    """Per-query NDCG averaged with query weights; all-negative queries
+    count as 1 (rank_metric.hpp:96-100).  Reports one value per eval_at
+    position via ``eval_multi``; ``eval`` returns the first position
+    (used for early stopping like the reference's metric vector head)."""
+
+    name = "ndcg"
+    bigger_is_better = True
+
+    def __init__(self, config):
+        self.eval_at = list(config.ndcg_eval_at) or [1, 2, 3, 4, 5]
+        self.gains = label_gains_from_config(config.label_gain)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("NDCG metric requires query information")
+        self.qb = np.asarray(metadata.query_boundaries)
+        self.query_weights = metadata.query_weights
+        nq = len(self.qb) - 1
+        self.sum_query_weights = (
+            float(nq) if self.query_weights is None else float(self.query_weights.sum())
+        )
+        # cache per-query ideal DCG at each eval position
+        self.max_dcgs = np.zeros((nq, len(self.eval_at)))
+        for q in range(nq):
+            lab = self.label[self.qb[q] : self.qb[q + 1]]
+            for ki, k in enumerate(self.eval_at):
+                self.max_dcgs[q, ki] = max_dcg_at_k(k, lab, self.gains)
+
+    def eval_multi(self, scores) -> List[float]:
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        nq = len(self.qb) - 1
+        acc = np.zeros(len(self.eval_at))
+        for q in range(nq):
+            beg, end = self.qb[q], self.qb[q + 1]
+            lab = self.label[beg:end]
+            order = np.argsort(-scores[beg:end], kind="stable")
+            w = 1.0 if self.query_weights is None else self.query_weights[q]
+            for ki, k in enumerate(self.eval_at):
+                if self.max_dcgs[q, ki] <= 0:
+                    acc[ki] += w  # no positive labels -> NDCG := 1
+                else:
+                    acc[ki] += (
+                        w * dcg_at_k(k, lab[order], self.gains) / self.max_dcgs[q, ki]
+                    )
+        return [float(a / self.sum_query_weights) for a in acc]
+
+    def eval(self, scores) -> float:
+        return self.eval_multi(scores)[0]

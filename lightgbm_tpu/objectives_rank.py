@@ -1,0 +1,175 @@
+"""LambdaRank-NDCG objective, TPU-native.
+
+Re-expresses LambdarankNDCG (src/objective/rank_objective.hpp:19-227) as a
+padded, vmapped pairwise computation: queries are padded to the maximum
+query length Q and processed in fixed-size chunks (``lax.map``), replacing
+the reference's per-query OpenMP loop (rank_objective.hpp:68-74) and its
+O(cnt^2) nested pair loops (rank_objective.hpp:109-156) with dense [C,Q,Q]
+tensor ops.  The 1M-entry sigmoid lookup table (rank_objective.hpp:179-192)
+is replaced by the exact sigmoid — table lookup is a CPU trick; the VPU
+evaluates exp directly.
+
+Per pair (high=rank i, low=rank j, label_high > label_low):
+  delta_ndcg = (gain[lh]-gain[ll]) * |disc_i - disc_j| * inv_max_dcg
+               [/ (0.01 + |s_h - s_l|) when best != worst score]
+  p        = 2 / (1 + exp(2*sigma*(s_h - s_l)))
+  lambda_h += -delta_ndcg * p        lambda_l -= -delta_ndcg * p
+  hess_{h,l} += 2 * delta_ndcg * p * (2 - p)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dcg import label_gains_from_config, max_dcg_at_k, position_discounts
+from .objectives import ObjectiveFunction
+
+
+class LambdarankNDCG(ObjectiveFunction):
+    name = "lambdarank"
+
+    def __init__(self, config):
+        if config.sigmoid <= 0:
+            raise ValueError("sigmoid parameter must be > 0")
+        self.sigmoid = float(config.sigmoid)
+        self.optimize_pos_at = int(config.max_position)
+        self._gains_np = label_gains_from_config(config.label_gain)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("Lambdarank tasks require query information")
+        qb = np.asarray(metadata.query_boundaries)
+        label_np = np.asarray(metadata.label)
+        nq = len(qb) - 1
+        sizes = np.diff(qb)
+        Q = int(sizes.max())
+        # padded row-index matrix; padding points at n (dropped on scatter)
+        pad_idx = np.full((nq, Q), num_data, np.int32)
+        valid = np.zeros((nq, Q), bool)
+        for q in range(nq):
+            c = sizes[q]
+            pad_idx[q, :c] = np.arange(qb[q], qb[q + 1])
+            valid[q, :c] = True
+        inv_max_dcg = np.zeros(nq, np.float64)
+        for q in range(nq):
+            m = max_dcg_at_k(
+                self.optimize_pos_at, label_np[qb[q] : qb[q + 1]], self._gains_np
+            )
+            inv_max_dcg[q] = 1.0 / m if m > 0 else 0.0
+        self._pad_idx = jnp.asarray(pad_idx)
+        self._valid = jnp.asarray(valid)
+        self._inv_max_dcg = jnp.asarray(inv_max_dcg, jnp.float32)
+        self._labels_padded = jnp.asarray(
+            np.where(valid, label_np[np.minimum(pad_idx, num_data - 1)], 0).astype(
+                np.int32
+            )
+        )
+        self._gains = jnp.asarray(self._gains_np, jnp.float32)
+        self._discounts = jnp.asarray(position_discounts(Q), jnp.float32)
+        self._Q = Q
+        # chunk queries to bound the [C, Q, Q] pairwise tensors to ~64MB
+        self._chunk = max(1, min(nq, (1 << 24) // max(Q * Q, 1)))
+
+    def get_gradients(self, scores):
+        return _lambdarank_grads(
+            scores,
+            self._pad_idx,
+            self._valid,
+            self._labels_padded,
+            self._inv_max_dcg,
+            self._gains,
+            self._discounts,
+            jnp.float32(self.sigmoid),
+            self.weights,
+            self.num_data,
+            self._chunk,
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("num_data", "chunk"))
+def _lambdarank_grads(
+    scores,
+    pad_idx,
+    valid,
+    labels,
+    inv_max_dcg,
+    gains,
+    discounts,
+    sigmoid,
+    weights,
+    num_data: int,
+    chunk: int,
+):
+    nq, Q = pad_idx.shape
+    # pad scores with a sentinel slot at index n
+    s_ext = jnp.concatenate([scores, jnp.zeros(1, scores.dtype)])
+
+    nchunks = -(-nq // chunk)
+    pad_q = nchunks * chunk - nq
+    if pad_q:
+        pad_idx = jnp.concatenate(
+            [pad_idx, jnp.full((pad_q, Q), num_data, pad_idx.dtype)]
+        )
+        valid = jnp.concatenate([valid, jnp.zeros((pad_q, Q), bool)])
+        labels = jnp.concatenate([labels, jnp.zeros((pad_q, Q), labels.dtype)])
+        inv_max_dcg = jnp.concatenate([inv_max_dcg, jnp.zeros(pad_q, inv_max_dcg.dtype)])
+
+    def one_chunk(args):
+        idx, vld, lab, imd = args
+        s = jnp.where(vld, s_ext[idx], -jnp.inf)  # [C, Q]
+        order = jnp.argsort(-s, axis=1, stable=True)  # rank -> slot
+        s_r = jnp.take_along_axis(s, order, axis=1)
+        l_r = jnp.take_along_axis(lab, order, axis=1)
+        v_r = jnp.take_along_axis(vld, order, axis=1)
+        cnt = vld.sum(axis=1)
+        best = s_r[:, 0]
+        worst = jnp.take_along_axis(
+            s_r, jnp.maximum(cnt - 1, 0)[:, None], axis=1
+        )[:, 0]
+        regularize = (best != worst)[:, None, None]
+
+        g_r = gains[jnp.clip(l_r, 0, gains.shape[0] - 1)]
+        D = s_r[:, :, None] - s_r[:, None, :]  # s_high - s_low
+        cond = (
+            (l_r[:, :, None] > l_r[:, None, :])
+            & v_r[:, :, None]
+            & v_r[:, None, :]
+        )
+        dcg_gap = g_r[:, :, None] - g_r[:, None, :]
+        pd = jnp.abs(discounts[None, :, None] - discounts[None, None, :])
+        dn = dcg_gap * pd * imd[:, None, None]
+        dn = jnp.where(regularize, dn / (0.01 + jnp.abs(D)), dn)
+        p = 2.0 / (1.0 + jnp.exp(jnp.clip(2.0 * sigmoid * D, -88.0, 88.0)))
+        lam = jnp.where(cond, -dn * p, 0.0)
+        hes = jnp.where(cond, 2.0 * dn * p * (2.0 - p), 0.0)
+        lam_r = lam.sum(axis=2) - lam.sum(axis=1)  # high gets +, low gets -
+        hes_r = hes.sum(axis=2) + hes.sum(axis=1)
+        # unsort back to slot order
+        C = idx.shape[0]
+        unsort = jnp.argsort(order, axis=1, stable=True)
+        lam_s = jnp.take_along_axis(lam_r, unsort, axis=1)
+        hes_s = jnp.take_along_axis(hes_r, unsort, axis=1)
+        return lam_s, hes_s
+
+    idx_c = pad_idx.reshape(nchunks, chunk, Q)
+    vld_c = valid.reshape(nchunks, chunk, Q)
+    lab_c = labels.reshape(nchunks, chunk, Q)
+    imd_c = inv_max_dcg.reshape(nchunks, chunk)
+    lam, hes = jax.lax.map(one_chunk, (idx_c, vld_c, lab_c, imd_c))
+
+    flat_idx = pad_idx.reshape(-1)
+    grad = jnp.zeros(num_data + 1, jnp.float32).at[flat_idx].add(lam.reshape(-1))[
+        :num_data
+    ]
+    hess = jnp.zeros(num_data + 1, jnp.float32).at[flat_idx].add(hes.reshape(-1))[
+        :num_data
+    ]
+    if weights is not None:
+        grad, hess = grad * weights, hess * weights
+    return grad, hess

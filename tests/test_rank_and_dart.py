@@ -1,0 +1,203 @@
+"""LambdaRank objective/NDCG metric and DART boosting tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dcg import (
+    dcg_at_k,
+    default_label_gains,
+    max_dcg_at_k,
+    position_discounts,
+)
+from lightgbm_tpu.io import BinnedDataset, Metadata
+from lightgbm_tpu.models.dart import DART, create_boosting
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.metrics_rank import NDCGMetric
+
+
+# ------------------------------------------------------------------ DCG utils
+def test_dcg_hand_case():
+    gains = default_label_gains()
+    # labels in score order [2, 0, 1]: dcg = 3/log2(2) + 0 + 1/log2(4)
+    labels = np.array([2, 0, 1])
+    assert abs(dcg_at_k(3, labels, gains) - (3.0 + 0.5)) < 1e-12
+    # ideal order [2, 1, 0]: 3 + 1/log2(3)
+    ideal = 3.0 + 1.0 / np.log2(3.0)
+    assert abs(max_dcg_at_k(3, labels, gains) - ideal) < 1e-12
+    assert abs(position_discounts(1)[0] - 1.0) < 1e-12
+
+
+def test_ndcg_metric_perfect_and_allzero():
+    cfg = Config.from_dict({"ndcg_eval_at": "1,3"})
+    m = NDCGMetric(cfg)
+    label = np.array([2, 1, 0, 0, 0, 0], np.float32)
+    meta = Metadata(label=label, query_boundaries=np.array([0, 3, 6]))
+    m.init(meta, 6)
+    # perfect ranking in query 0; query 1 all-zero -> counts as 1
+    scores = np.array([3.0, 2.0, 1.0, 0.1, 0.2, 0.3])
+    vals = m.eval_multi(scores)
+    assert all(abs(v - 1.0) < 1e-12 for v in vals)
+    # inverted ranking in query 0 lowers NDCG below 1
+    scores_bad = np.array([1.0, 2.0, 3.0, 0.1, 0.2, 0.3])
+    assert m.eval_multi(scores_bad)[1] < 1.0
+
+
+# ---------------------------------------------------------------- lambdarank
+def _rank_oracle_grads(label, score, qb, sigma, max_pos, gains):
+    """Direct numpy transcription of the reference pair loop
+    (rank_objective.hpp:109-156) as an executable spec."""
+    n = len(label)
+    lam = np.zeros(n)
+    hes = np.zeros(n)
+    disc = lambda i: 1.0 / np.log2(2.0 + i)
+    for q in range(len(qb) - 1):
+        beg, end = qb[q], qb[q + 1]
+        lab = label[beg:end].astype(int)
+        s = score[beg:end]
+        cnt = end - beg
+        mx = max_dcg_at_k(max_pos, lab, gains)
+        inv = 1.0 / mx if mx > 0 else 0.0
+        order = np.argsort(-s, kind="stable")
+        best, worst = s[order[0]], s[order[cnt - 1]]
+        for i in range(cnt):
+            hi = order[i]
+            for j in range(cnt):
+                if i == j:
+                    continue
+                lo = order[j]
+                if lab[hi] <= lab[lo]:
+                    continue
+                ds = s[hi] - s[lo]
+                dn = (gains[lab[hi]] - gains[lab[lo]]) * abs(disc(i) - disc(j)) * inv
+                if best != worst:
+                    dn /= 0.01 + abs(ds)
+                p = 2.0 / (1.0 + np.exp(2.0 * sigma * ds))
+                pl = -dn * p
+                ph = 2.0 * dn * p * (2.0 - p)
+                lam[beg + hi] += pl
+                hes[beg + hi] += ph
+                lam[beg + lo] -= pl
+                hes[beg + lo] += ph
+    return lam, hes
+
+
+def test_lambdarank_gradients_match_oracle():
+    rng = np.random.RandomState(0)
+    qb = np.array([0, 5, 12, 30, 31])  # uneven queries incl. singleton
+    n = 31
+    label = rng.randint(0, 4, n).astype(np.float32)
+    score = rng.randn(n).astype(np.float32)
+    cfg = Config.from_dict({"objective": "lambdarank", "sigmoid": "2.0"})
+    meta = Metadata(label=label, query_boundaries=qb)
+    obj = create_objective(cfg, meta, n)
+    g, h = obj.get_gradients(np.asarray(score))
+    og, oh = _rank_oracle_grads(
+        label, score.astype(np.float64), qb, 2.0, cfg.max_position,
+        default_label_gains(),
+    )
+    np.testing.assert_allclose(np.asarray(g), og, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), oh, rtol=1e-4, atol=1e-5)
+
+
+def test_lambdarank_end_to_end(reference_examples):
+    cfg = Config.from_dict(
+        {
+            "objective": "lambdarank",
+            "metric": "ndcg",
+            "ndcg_eval_at": "1,3,5",
+            "num_leaves": "31",
+            "min_data_in_leaf": "10",
+            "min_sum_hessian_in_leaf": "0.001",
+            "learning_rate": "0.1",
+            "sigmoid": "2",
+        }
+    )
+    d = os.path.join(reference_examples, "lambdarank")
+    train = BinnedDataset.from_file(os.path.join(d, "rank.train"), cfg)
+    test = BinnedDataset.from_file(os.path.join(d, "rank.test"), cfg, reference=train)
+    obj = create_objective(cfg, train.metadata, train.num_data)
+    g = GBDT(cfg, train, obj)
+    g.add_valid_dataset(test, "test")
+    for _ in range(30):
+        g.train_one_iter()
+    ndcg = g.valid_metrics[0][0].eval_multi(g.predict_at(1)[0])
+    # the reference binary with this exact config reaches valid ndcg@3
+    # 0.6036 / ndcg@5 0.6418 at iter 30 (run 2026-07); require parity
+    assert ndcg[1] > 0.60, ndcg
+    assert ndcg[2] > 0.63, ndcg
+
+
+# ----------------------------------------------------------------------- DART
+def test_dart_trains_and_normalizes(reference_examples):
+    cfg = Config.from_dict(
+        {
+            "objective": "binary",
+            "boosting": "dart",
+            "drop_rate": "0.5",
+            "skip_drop": "0.0",
+            "num_leaves": "15",
+            "min_data_in_leaf": "50",
+            "min_sum_hessian_in_leaf": "5",
+            "learning_rate": "0.1",
+            "metric": "binary_logloss",
+        }
+    )
+    d = os.path.join(reference_examples, "binary_classification")
+    train = BinnedDataset.from_file(os.path.join(d, "binary.train"), cfg)
+    test = BinnedDataset.from_file(os.path.join(d, "binary.test"), cfg, reference=train)
+    b = create_boosting(cfg, train, create_objective(cfg, train.metadata, train.num_data))
+    assert isinstance(b, DART)
+    b.add_valid_dataset(test, "t")
+    first = None
+    for _ in range(15):
+        b.train_one_iter()
+        if first is None:
+            first = b.eval_at(1)["binary_logloss"]
+    last = b.eval_at(1)["binary_logloss"]
+    assert last < first < 0.6932
+    # internal consistency: recomputing valid score from stored (normalized)
+    # trees must match the incrementally-maintained valid score
+    from lightgbm_tpu.models.tree import predict_binned
+    import jax.numpy as jnp
+
+    vb = b._valid_bins[0]
+    total = np.zeros(test.num_data)
+    for t in b.models:
+        total += np.asarray(predict_binned(t, vb))
+    np.testing.assert_allclose(
+        total, np.asarray(b._valid_scores[0][0]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_dart_train_score_consistency(reference_examples):
+    cfg = Config.from_dict(
+        {
+            "objective": "regression",
+            "boosting": "dart",
+            "drop_rate": "0.3",
+            "skip_drop": "0.2",
+            "num_leaves": "7",
+            "min_data_in_leaf": "20",
+            "min_sum_hessian_in_leaf": "1",
+            "metric": "l2",
+        }
+    )
+    d = os.path.join(reference_examples, "regression")
+    train = BinnedDataset.from_file(os.path.join(d, "regression.train"), cfg)
+    b = create_boosting(cfg, train, create_objective(cfg, train.metadata, train.num_data))
+    for _ in range(10):
+        b.train_one_iter()
+    from lightgbm_tpu.models.tree import predict_binned
+    import jax.numpy as jnp
+
+    total = np.zeros(train.num_data)
+    bins = jnp.asarray(train.X_bin)
+    for t in b.models:
+        total += np.asarray(predict_binned(t, bins))
+    np.testing.assert_allclose(
+        total, np.asarray(b._scores[0]), rtol=1e-4, atol=1e-5
+    )
