@@ -91,9 +91,33 @@ def _set_best(best: SplitResult, i, new: SplitResult) -> SplitResult:
     return SplitResult(*[b.at[i].set(n) for b, n in zip(best, new)])
 
 
+def default_search_fn(
+    hist, sum_grad, sum_hess, count, can_split,
+    feature_mask, num_bins_per_feature, is_categorical, params,
+):
+    """Local split search over the full feature set (the serial learner's
+    FindBestThresholds).  Parallel learners substitute variants that search
+    a feature shard and combine across the mesh."""
+    return find_best_split(
+        hist,
+        sum_grad,
+        sum_hess,
+        count,
+        feature_mask,
+        num_bins_per_feature,
+        is_categorical,
+        params.min_data_in_leaf,
+        params.min_sum_hessian_in_leaf,
+        params.lambda_l1,
+        params.lambda_l2,
+        params.min_gain_to_split,
+        can_split,
+    )
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "max_leaves", "hist_fn"),
+    static_argnames=("num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn"),
 )
 def grow_tree(
     bins_T: jax.Array,  # [F, n] feature-major binned matrix
@@ -107,35 +131,31 @@ def grow_tree(
     num_bins: int,
     max_leaves: int,
     hist_fn=None,
+    reduce_fn=None,
+    search_fn=None,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
     ``hist_fn(bins_T, grad, hess, mask) -> [F, B, 3]`` abstracts histogram
     construction so the data-parallel learner can psum across the mesh;
-    default is the local kernel.
+    default is the local kernel.  ``reduce_fn`` (cross-device sum) is
+    applied to the root (Σg, Σh, count) scalars — the analog of the
+    data-parallel learner's tree-start allreduce
+    (data_parallel_tree_learner.cpp:97-125).
     """
     F, n = bins_T.shape
     L = max_leaves
 
     if hist_fn is None:
         hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
+    if search_fn is None:
+        search_fn = default_search_fn
 
     def best_for(hist, sg, sh, c, depth_child):
         can = (params.max_depth <= 0) | (depth_child < params.max_depth)
-        return find_best_split(
-            hist,
-            sg,
-            sh,
-            c,
-            feature_mask,
-            num_bins_per_feature,
-            is_categorical,
-            params.min_data_in_leaf,
-            params.min_sum_hessian_in_leaf,
-            params.lambda_l1,
-            params.lambda_l2,
-            params.min_gain_to_split,
-            can,
+        return search_fn(
+            hist, sg, sh, c, can,
+            feature_mask, num_bins_per_feature, is_categorical, params,
         )
 
     # ---- root (BeforeTrain / LeafSplits::Init, leaf_splits.hpp:51-92)
@@ -143,10 +163,13 @@ def grow_tree(
     sum_g0 = jnp.sum(grad * bag_mask)
     sum_h0 = jnp.sum(hess * bag_mask)
     cnt0 = jnp.sum(bag_mask)
+    if reduce_fn is not None:
+        sum_g0, sum_h0, cnt0 = reduce_fn(sum_g0), reduce_fn(sum_h0), reduce_fn(cnt0)
 
+    # hist0's feature extent may be a shard of F (feature-parallel learner)
     state = _GrowState(
         leaf_id=jnp.zeros(n, jnp.int32),
-        hists=jnp.zeros((L, F, num_bins, 3), jnp.float32).at[0].set(hist0),
+        hists=jnp.zeros((L,) + hist0.shape, jnp.float32).at[0].set(hist0),
         sum_g=jnp.zeros(L, jnp.float32).at[0].set(sum_g0),
         sum_h=jnp.zeros(L, jnp.float32).at[0].set(sum_h0),
         cnt=jnp.zeros(L, jnp.float32).at[0].set(cnt0),

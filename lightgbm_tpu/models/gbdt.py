@@ -66,7 +66,6 @@ class GBDT:
         self.train_metrics: List[Metric] = []
         self.valid_metrics: List[List[Metric]] = []
         self.best_iteration = -1
-        self._hist_fn = None  # parallel learners override (stage: mesh)
         self._bag_rng = np.random.RandomState(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
         if train_set is not None:
@@ -93,6 +92,7 @@ class GBDT:
         self._learner_params = TreeLearnerParams.from_config(self.config)
         self._real_feat = train_set.real_feature_indices
         self._bin_thresholds = train_set.bin_thresholds_real()
+        self._grow = self._create_tree_learner()
 
         K = self.num_class
         init = train_set.metadata.init_score
@@ -110,6 +110,42 @@ class GBDT:
         )
         # rollback support: keep per-iteration train score deltas off-device?
         # cheaper: recompute on rollback from stored trees (rare path).
+
+    def _create_tree_learner(self):
+        """TreeLearner::CreateTreeLearner (tree_learner.cpp:8-20): map
+        config.tree_learner to a grow callable.  All parallel variants run
+        SPMD over the local device mesh — the reference's `num_machines`
+        world (network.cpp:20-38) is the mesh's row axis."""
+        tl = self.config.tree_learner
+        if tl == "serial" or len(jax.devices()) == 1:
+            return functools.partial(
+                grow_tree, num_bins=self._num_bins, max_leaves=self.max_leaves
+            )
+        from ..parallel import (
+            data_mesh,
+            make_data_parallel_grower,
+            make_feature_parallel_grower,
+            make_voting_parallel_grower,
+        )
+
+        nd = len(jax.devices())
+        if self.config.num_machines > 1:
+            nd = min(nd, self.config.num_machines)
+        mesh = data_mesh(num_devices=nd)
+        if tl == "feature":
+            return make_feature_parallel_grower(
+                mesh, num_bins=self._num_bins, max_leaves=self.max_leaves
+            )
+        if tl == "voting":
+            return make_voting_parallel_grower(
+                mesh,
+                num_bins=self._num_bins,
+                max_leaves=self.max_leaves,
+                top_k=self.config.top_k,
+            )
+        return make_data_parallel_grower(
+            mesh, num_bins=self._num_bins, max_leaves=self.max_leaves
+        )
 
     def add_valid_dataset(self, valid_set: BinnedDataset, name: str) -> None:
         """GBDT::AddValidDataset (gbdt.cpp:124-140)."""
@@ -198,7 +234,7 @@ class GBDT:
         could_split_any = False
         for k in range(K):
             fmask = self._sample_features()
-            tree, leaf_id = grow_tree(
+            tree, leaf_id = self._grow(
                 self._bins_T,
                 grad[k],
                 hess[k],
@@ -207,9 +243,6 @@ class GBDT:
                 self._nbpf,
                 self._is_cat,
                 self._learner_params,
-                num_bins=self._num_bins,
-                max_leaves=self.max_leaves,
-                hist_fn=self._hist_fn,
             )
             tree = tree.shrink(jnp.float32(self.learning_rate))
             if int(tree.num_leaves) > 1:

@@ -3,14 +3,22 @@ multi-device (mesh) code paths run without TPU hardware."""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere.  Force CPU even when the
+# outer environment points at real TPU hardware (JAX_PLATFORMS=axon):
+# the suite's multi-device tests need 8 devices, and the driver's bench
+# run — not the test suite — is what exercises the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon TPU plugin can override JAX_PLATFORMS at import; pin it here.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,99 @@
+"""Feature-parallel and voting-parallel learners vs the serial learner.
+
+Feature-parallel replicates data and shards only the search, so its
+histogram arithmetic is bit-identical to serial — trees must match
+EXACTLY (the reference invariant, split_info.hpp:98-103).  Voting is an
+approximation by design; with 2*top_k >= num_features it degenerates to
+full data-parallel and must match up to reduction order.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.learners.serial import TreeLearnerParams, grow_tree
+from lightgbm_tpu.parallel import (
+    data_mesh,
+    make_feature_parallel_grower,
+    make_voting_parallel_grower,
+)
+
+
+def _problem(n, F, B, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8)),
+        jnp.asarray(rng.randn(n).astype(np.float32)),
+        jnp.asarray((np.abs(rng.randn(n)) + 0.1).astype(np.float32)),
+        jnp.ones(n, jnp.float32),
+        jnp.ones(F, bool),
+        jnp.full(F, B, jnp.int32),
+        jnp.zeros(F, bool),
+    )
+
+
+def _params():
+    return TreeLearnerParams.from_config(
+        Config(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+    )
+
+
+def test_feature_parallel_exact_match():
+    n, F, B, L = 700, 13, 32, 31  # F=13 exercises ragged feature shards
+    args = _problem(n, F, B, seed=5)
+    params = _params()
+    t_s, leaf_s = grow_tree(*args, params, num_bins=B, max_leaves=L)
+    grow_fp = make_feature_parallel_grower(data_mesh(), num_bins=B, max_leaves=L)
+    t_f, leaf_f = grow_fp(*args, params)
+
+    assert int(t_s.num_leaves) == int(t_f.num_leaves) > 4
+    nl = int(t_s.num_leaves)
+    for field in ("split_feature", "threshold_bin", "left_child", "right_child"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_s, field))[: nl - 1],
+            np.asarray(getattr(t_f, field))[: nl - 1],
+            err_msg=field,
+        )
+    np.testing.assert_allclose(
+        np.asarray(t_s.leaf_value)[:nl], np.asarray(t_f.leaf_value)[:nl], rtol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_f))
+
+
+def test_voting_parallel_degenerate_matches_serial():
+    n, F, B, L = 640, 8, 16, 15
+    args = _problem(n, F, B, seed=9)
+    params = _params()
+    t_s, _ = grow_tree(*args, params, num_bins=B, max_leaves=L)
+    # top_k=8 -> k2 = min(16, 8) = 8 = F: full feature set voted in
+    grow_v = make_voting_parallel_grower(
+        data_mesh(), num_bins=B, max_leaves=L, top_k=8
+    )
+    t_v, _ = grow_v(*args, params)
+    assert int(t_s.num_leaves) == int(t_v.num_leaves)
+    nl = int(t_s.num_leaves)
+    mismatch = sum(
+        int(np.asarray(t_s.split_feature)[i]) != int(np.asarray(t_v.split_feature)[i])
+        or int(np.asarray(t_s.threshold_bin)[i]) != int(np.asarray(t_v.threshold_bin)[i])
+        for i in range(nl - 1)
+    )
+    assert mismatch <= 1  # reduction-order near-ties only
+
+
+def test_voting_parallel_restricted_topk_still_learns():
+    """With a tight top_k the tree may differ but must still find signal."""
+    rng = np.random.RandomState(2)
+    n, F, B, L = 800, 20, 16, 15
+    bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+    # plant signal on feature 17
+    y = (bins[17] > B // 2).astype(np.float32)
+    grad = jnp.asarray((0.5 - y).astype(np.float32))
+    hess = jnp.ones(n, jnp.float32) * 0.25
+    args = (
+        jnp.asarray(bins), grad, hess, jnp.ones(n, jnp.float32),
+        jnp.ones(F, bool), jnp.full(F, B, jnp.int32), jnp.zeros(F, bool),
+    )
+    grow_v = make_voting_parallel_grower(data_mesh(), num_bins=B, max_leaves=L, top_k=2)
+    t_v, _ = grow_v(*args, _params())
+    assert int(np.asarray(t_v.split_feature)[0]) == 17
