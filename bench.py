@@ -1,0 +1,178 @@
+"""Benchmark: GBDT training throughput on a HIGGS-like synthetic workload.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload (mirrors BASELINE.json config #2 scaled down): binary
+classification, 28 continuous features, 255 bins, 255 leaves.
+``vs_baseline`` is the speedup of this framework (on the default JAX
+device — the TPU chip under the driver) over the REFERENCE LightGBM CLI
+built from /root/reference and run on the same machine's CPU with the
+same data and parameters.  The reference baseline (sec/tree) is measured
+once and cached in .bench/baseline_<key>.json.
+
+Env overrides: BENCH_ROWS (default 1e6), BENCH_TREES (default 10),
+BENCH_BUDGET_S (wall budget for the timed section, default 300).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(float(os.environ.get("BENCH_ROWS", 1_000_000)))
+TREES = int(os.environ.get("BENCH_TREES", 10))
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 300))
+N_FEAT, NUM_BINS, NUM_LEAVES = 28, 255, 255
+LEARNING_RATE, MIN_DATA = 0.1, 100
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_data(n: int, seed: int = 7):
+    """HIGGS-like: 28 correlated features, nonlinear decision boundary."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, N_FEAT).astype(np.float32)
+    w1, w2 = rng.randn(N_FEAT), rng.randn(N_FEAT)
+    z = X @ w1 + 0.5 * (X**2 - 1.0) @ w2 + 0.8 * X[:, 0] * X[:, 1]
+    z = (z - z.mean()) / z.std()
+    y = (z + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+# --------------------------------------------------------------- reference
+def build_reference_cli() -> str | None:
+    """Build the reference LightGBM CLI from a /tmp copy (its CMake writes
+    the binary into the source tree, which must stay untouched)."""
+    exe = "/tmp/lgbm_ref_src/lightgbm"
+    if os.path.exists(exe):
+        return exe
+    if not os.path.isdir("/root/reference"):
+        return None
+    try:
+        shutil.copytree("/root/reference", "/tmp/lgbm_ref_src", dirs_exist_ok=True)
+        os.makedirs("/tmp/lgbm_ref_build", exist_ok=True)
+        subprocess.run(
+            ["cmake", "-DCMAKE_POLICY_VERSION_MINIMUM=3.5",
+             "-DCMAKE_BUILD_TYPE=Release",
+             "-DCMAKE_CXX_FLAGS=-include limits", "/tmp/lgbm_ref_src"],
+            cwd="/tmp/lgbm_ref_build", check=True, capture_output=True)
+        subprocess.run(["make", "-j4", "lightgbm"], cwd="/tmp/lgbm_ref_build",
+                       check=True, capture_output=True)
+        return exe if os.path.exists(exe) else None
+    except Exception as e:  # baseline is best-effort
+        log(f"reference build failed: {e}")
+        return None
+
+
+def reference_sec_per_tree(X, y, key: str) -> float | None:
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    cache = os.path.join(CACHE_DIR, f"baseline_{key}.json")
+    if os.path.exists(cache):
+        with open(cache) as fh:
+            return json.load(fh)["sec_per_tree"]
+    exe = build_reference_cli()
+    if exe is None:
+        return None
+    data_path = f"/tmp/bench_{key}.csv"
+    if not os.path.exists(data_path):
+        log("writing reference CSV ...")
+        arr = np.column_stack([y, X])
+        np.savetxt(data_path, arr, fmt="%.6g", delimiter=",")
+    conf = [
+        "task=train", f"data={data_path}", "objective=binary",
+        f"num_trees={TREES}", f"num_leaves={NUM_LEAVES}",
+        f"max_bin={NUM_BINS}", f"learning_rate={LEARNING_RATE}",
+        f"min_data_in_leaf={MIN_DATA}", "verbosity=1",
+        "output_model=/tmp/bench_ref_model.txt", "is_save_binary_file=false",
+    ]
+    log("running reference CLI baseline ...")
+    t0 = time.perf_counter()
+    proc = subprocess.run([exe] + conf, capture_output=True, text=True,
+                          timeout=3600)
+    total = time.perf_counter() - t0
+    if proc.returncode != 0:
+        log(f"reference run failed: {proc.stdout[-500:]} {proc.stderr[-500:]}")
+        return None
+    # isolate training time from data loading via the CLI's own iter log
+    sec = None
+    for line in proc.stdout.splitlines():
+        if "seconds elapsed, finished iteration" in line:
+            sec = float(line.split("]")[-1].strip().split()[0])
+    sec_per_tree = (sec / TREES) if sec else total / TREES
+    with open(cache, "w") as fh:
+        json.dump({"sec_per_tree": sec_per_tree, "total_s": total,
+                   "trees": TREES, "rows": ROWS}, fh)
+    log(f"reference baseline: {sec_per_tree:.3f}s/tree (total {total:.1f}s)")
+    return sec_per_tree
+
+
+# --------------------------------------------------------------------- ours
+def ours_sec_per_tree(X, y) -> tuple[float, float]:
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    log(f"devices: {jax.devices()}")
+    cfg = Config(
+        objective="binary", num_leaves=NUM_LEAVES, max_bin=NUM_BINS,
+        learning_rate=LEARNING_RATE, min_data_in_leaf=MIN_DATA,
+        metric=["auc"],
+    )
+    t0 = time.perf_counter()
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    log(f"binning: {time.perf_counter() - t0:.1f}s")
+    obj = create_objective(cfg, ds.metadata, ds.num_data)
+    booster = GBDT(cfg, ds, obj)
+
+    # warmup: first iteration compiles
+    t0 = time.perf_counter()
+    booster.train_one_iter()
+    _ = np.asarray(booster._scores)  # force completion (async dispatch)
+    log(f"compile + first tree: {time.perf_counter() - t0:.1f}s")
+
+    done = 0
+    t0 = time.perf_counter()
+    for _ in range(TREES):
+        booster.train_one_iter()
+        _ = np.asarray(booster._scores[0, :1])
+        done += 1
+        if time.perf_counter() - t0 > BUDGET_S:
+            log(f"budget hit after {done} trees")
+            break
+    _ = np.asarray(booster._scores)
+    elapsed = time.perf_counter() - t0
+    auc = booster.eval_at(0).get("auc", float("nan"))
+    log(f"ours: {done} trees in {elapsed:.1f}s, train AUC={auc:.4f}")
+    return elapsed / done, auc
+
+
+def main() -> None:
+    key = f"r{ROWS}_t{TREES}_l{NUM_LEAVES}_b{NUM_BINS}"
+    X, y = make_data(ROWS)
+    ours, auc = ours_sec_per_tree(X, y)
+    ref = reference_sec_per_tree(X, y, key)
+    vs = (ref / ours) if (ref and ours > 0) else 0.0
+    print(json.dumps({
+        "metric": f"gbdt_train_sec_per_tree_higgslike_{ROWS//1000}k",
+        "value": round(ours, 4),
+        "unit": "s/tree",
+        "vs_baseline": round(vs, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
