@@ -12,5 +12,41 @@ __version__ = "0.1.0"
 
 from .config import Config  # noqa: F401
 from .io import BinMapper, BinnedDataset, Metadata  # noqa: F401
+from .basic import Booster, Dataset, LightGBMError  # noqa: F401
+from .callback import (  # noqa: F401
+    EarlyStopException,
+    early_stopping,
+    print_evaluation,
+    record_evaluation,
+    reset_parameter,
+)
+from .engine import CVBooster, cv, train  # noqa: F401
+from .sklearn import (  # noqa: F401
+    LGBMClassifier,
+    LGBMModel,
+    LGBMRanker,
+    LGBMRegressor,
+)
 
-__all__ = ["Config", "BinMapper", "BinnedDataset", "Metadata", "__version__"]
+__all__ = [
+    "Config",
+    "BinMapper",
+    "BinnedDataset",
+    "Metadata",
+    "Dataset",
+    "Booster",
+    "LightGBMError",
+    "train",
+    "cv",
+    "CVBooster",
+    "print_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "early_stopping",
+    "EarlyStopException",
+    "LGBMModel",
+    "LGBMRegressor",
+    "LGBMClassifier",
+    "LGBMRanker",
+    "__version__",
+]

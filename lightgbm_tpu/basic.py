@@ -1,0 +1,466 @@
+"""User-facing ``Dataset`` and ``Booster``.
+
+Mirrors the reference python package's basic.py (python-package/lightgbm/
+basic.py:930 ``Dataset``, basic.py:1276 ``Booster``) — same lazy-construction
+semantics, same method surface — but with no FFI: the "C API layer" the
+reference reaches through ctypes (src/c_api.cpp) is here the in-process
+TPU framework itself (BinnedDataset + GBDT/DART on JAX).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, key_alias_transform
+from .io.dataset import BinnedDataset
+from .io.metadata import Metadata
+from .metrics import Metric, create_metrics
+from .models.dart import create_boosting
+from .models.gbdt import GBDT
+from .objectives import create_objective
+
+
+class LightGBMError(Exception):
+    """Error raised by the framework (reference basic.py:45)."""
+
+
+def _to_2d_float(data) -> np.ndarray:
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    if arr.ndim != 2:
+        raise LightGBMError("data must be 2 dimensional")
+    return arr
+
+
+def _densify(data) -> np.ndarray:
+    """Accept numpy / pandas / scipy-sparse row data (basic.py:472-927)."""
+    if hasattr(data, "toarray"):  # scipy CSR/CSC
+        return _to_2d_float(data.toarray())
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
+        return _to_2d_float(np.asarray(data.values, dtype=np.float64))
+    return _to_2d_float(data)
+
+
+class Dataset:
+    """Dataset for training/validation.
+
+    Like the reference ``Dataset`` (basic.py:930-1274): parameters
+    (max_bin, categorical_feature, reference, ...) are collected eagerly
+    but binning happens lazily on first use, so a validation set can be
+    aligned to its training set's bin mappers.
+    """
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        max_bin: int = 256,
+        reference: Optional["Dataset"] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name: Optional[List[str]] = None,
+        categorical_feature: Optional[Sequence[int]] = None,
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = False,
+    ):
+        self.data = data
+        self.label = label
+        self.max_bin = int(max_bin)
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = list(categorical_feature or [])
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[BinnedDataset] = None
+
+    # ------------------------------------------------------------ construct
+    def construct(self) -> BinnedDataset:
+        """Build the binned dataset lazily (basic.py:1014-1036)."""
+        if self._inner is not None:
+            return self._inner
+        params = key_alias_transform(dict(self.params))
+        params.setdefault("max_bin", self.max_bin)
+        cfg = Config.from_dict(params)
+        meta_kwargs = dict(
+            label=None if self.label is None else np.asarray(self.label),
+            weights=self.weight,
+            init_score=self.init_score,
+        )
+        meta = Metadata(**meta_kwargs)
+        if self.group is not None:
+            meta.set_field("group", np.asarray(self.group))
+
+        ref_inner = self.reference.construct() if self.reference is not None else None
+        if isinstance(self.data, str):
+            self._inner = BinnedDataset.from_file(
+                self.data, config=cfg, reference=ref_inner
+            )
+            if meta.label is not None:
+                self._inner.metadata.set_field("label", meta.label)
+            for field in ("weight", "init_score"):
+                v = meta.get_field(field)
+                if v is not None:
+                    self._inner.metadata.set_field(field, v)
+            if meta.query_boundaries is not None:
+                self._inner.metadata.query_boundaries = meta.query_boundaries
+                self._inner.metadata._finish()
+        else:
+            X = _densify(self.data)
+            if meta.label is None:
+                raise LightGBMError("label should not be None for training data")
+            if ref_inner is not None:
+                self._inner = ref_inner.align_with(X, meta)
+            else:
+                self._inner = BinnedDataset.from_matrix(
+                    X,
+                    meta,
+                    config=cfg,
+                    categorical_features=self.categorical_feature,
+                    feature_names=self.feature_name,
+                )
+        if self.free_raw_data:
+            self.data = None
+        return self._inner
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        """A validation set aligned to this dataset (basic.py:1074-1097)."""
+        return Dataset(
+            data, label=label, reference=self, weight=weight, group=group,
+            init_score=init_score, params=params or self.params,
+        )
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing this dataset's bin mappers (basic.py:1099)."""
+        inner = self.construct().subset(np.asarray(used_indices))
+        out = Dataset.__new__(Dataset)
+        out.__dict__.update(
+            data=None, label=None, max_bin=self.max_bin, reference=self,
+            weight=None, group=None, init_score=None, feature_name=self.feature_name,
+            categorical_feature=self.categorical_feature,
+            params=dict(params or self.params), free_raw_data=True, _inner=inner,
+        )
+        return out
+
+    def save_binary(self, filename: str) -> None:
+        self.construct().save_binary(filename)
+
+    # -------------------------------------------------------------- fields
+    def set_field(self, field_name: str, data) -> None:
+        if self._inner is not None:
+            self._inner.metadata.set_field(field_name, data)
+        if field_name == "label":
+            self.label = data
+        elif field_name == "weight":
+            self.weight = data
+        elif field_name in ("group", "query"):
+            self.group = data
+        elif field_name == "init_score":
+            self.init_score = data
+
+    def get_field(self, field_name: str):
+        if self._inner is not None:
+            return self._inner.metadata.get_field(field_name)
+        return {
+            "label": self.label, "weight": self.weight,
+            "group": self.group, "query": self.group,
+            "init_score": self.init_score,
+        }.get(field_name)
+
+    set_label = lambda self, label: self.set_field("label", label)
+    set_weight = lambda self, weight: self.set_field("weight", weight)
+    set_group = lambda self, group: self.set_field("group", group)
+    set_init_score = lambda self, s: self.set_field("init_score", s)
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        return self.construct().num_data
+
+    def num_feature(self) -> int:
+        return self.construct().num_total_features
+
+
+def _is_finished_name(name: str) -> str:
+    return name
+
+
+class Booster:
+    """The boosting model (reference basic.py:1276-1819).
+
+    Construct with either ``train_set`` (training mode), ``model_file``
+    (prediction mode), or ``model_str``.
+    """
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+    ):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self._train_dataset: Optional[Dataset] = None
+        self.name_valid_sets: List[str] = []
+        self._feval_metric_cache: Dict[int, List[Metric]] = {}
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise LightGBMError("Training data should be Dataset instance")
+            cfg = Config.from_dict(self.params)
+            inner_train = train_set.construct()
+            objective = None
+            if cfg.objective != "none":
+                objective = create_objective(cfg, inner_train.metadata, inner_train.num_data)
+            self._gbdt = create_boosting(cfg, inner_train, objective)
+            self.config = cfg
+            self._train_dataset = train_set
+            if cfg.input_model:
+                init = Booster(model_file=cfg.input_model)
+                self._gbdt.merge_from(init._gbdt, prepend=True)
+        elif model_file is not None:
+            with open(model_file, "r") as fh:
+                model_str = fh.read()
+            self._init_from_string(model_str)
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        else:
+            raise LightGBMError(
+                "Booster needs at least one of train_set, model_file, model_str"
+            )
+
+    def _init_from_string(self, model_str: str) -> None:
+        cfg = Config.from_dict(self.params)
+        first = model_str.lstrip().splitlines()[0].strip()
+        # model-file type sniffing (boosting.cpp:7-16)
+        if first == "dart":
+            from .models.dart import DART
+
+            self._gbdt = DART(cfg)
+        else:
+            self._gbdt = GBDT(cfg)
+        self._gbdt.load_model_from_string(model_str)
+        self.config = cfg
+
+    # ------------------------------------------------------------- training
+    def add_valid(self, data: Dataset, name: str) -> None:
+        """basic.py:1388 / LGBM_BoosterAddValidData."""
+        if not isinstance(data, Dataset):
+            raise LightGBMError("Validation data should be Dataset instance")
+        self._gbdt.add_valid_dataset(data.construct(), name)
+        self.name_valid_sets.append(name)
+
+    def update(self, train_set: Optional[Dataset] = None, fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration; returns True if no further training is
+        possible (basic.py:1431-1501)."""
+        if train_set is not None and train_set is not self._train_dataset:
+            inner = train_set.construct()
+            obj = create_objective(self.config, inner.metadata, inner.num_data) \
+                if self.config.objective != "none" else None
+            self._gbdt.reset_training_data(inner, obj)
+            self._train_dataset = train_set
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        grad, hess = fobj(self.__inner_predict_flat(0), self._train_dataset)
+        grad = np.asarray(grad, np.float32)
+        hess = np.asarray(hess, np.float32)
+        n = self._gbdt.num_data * self._gbdt.num_class
+        if len(grad) != n or len(hess) != n:
+            raise LightGBMError(
+                f"Lengths of gradient({len(grad)}) and hessian({len(hess)}) "
+                f"don't match training rows x classes ({n})"
+            )
+        return self._gbdt.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> None:
+        self._gbdt.rollback_one_iter()
+
+    def reset_parameter(self, params: Dict[str, Any]) -> None:
+        """Subset of parameters resettable mid-training (learning_rate et al;
+        reference LGBM_BoosterResetParameter path)."""
+        params = key_alias_transform(dict(params))
+        for k, v in params.items():
+            if hasattr(self.config, k):
+                setattr(self.config, k, type(getattr(self.config, k))(v))
+        if "learning_rate" in params:
+            self._gbdt.learning_rate = float(params["learning_rate"])
+        self.params.update(params)
+
+    # ----------------------------------------------------------------- eval
+    def __inner_predict_flat(self, data_idx: int) -> np.ndarray:
+        s = self._gbdt.predict_at(data_idx)  # [K, n]
+        return s.reshape(-1)  # class-major flatten, matching the reference
+
+    def eval(self, data: Union[int, Dataset], name: str, feval=None):
+        """Evaluate on train (0) / added valid sets; returns the reference's
+        (data_name, eval_name, result, is_higher_better) tuples."""
+        if isinstance(data, int):
+            data_idx = data
+        else:
+            if data is self._train_dataset:
+                data_idx = 0
+            else:
+                inner = data.construct()
+                data_idx = 1 + next(
+                    i for i, vs in enumerate(self._gbdt.valid_sets) if vs is inner
+                )
+        return self.__eval_at(data_idx, name, feval)
+
+    def eval_train(self, feval=None):
+        return self.__eval_at(0, "training", feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i, name in enumerate(self.name_valid_sets):
+            out.extend(self.__eval_at(i + 1, name, feval))
+        return out
+
+    def __eval_at(self, data_idx: int, name: str, feval=None):
+        gb = self._gbdt
+        metrics = gb.train_metrics if data_idx == 0 else gb.valid_metrics[data_idx - 1]
+        scores = gb.predict_at(data_idx)
+        s = scores if gb.num_class > 1 else scores[0]
+        out = []
+        for m in metrics:
+            if hasattr(m, "eval_multi"):
+                for k, v in zip(m.eval_at, m.eval_multi(s)):
+                    out.append((name, f"{m.name}@{k}", v, m.bigger_is_better))
+            else:
+                out.append((name, m.name, m.eval(s), m.bigger_is_better))
+        if feval is not None:
+            ds = self._train_dataset if data_idx == 0 else _DatasetView(
+                gb.valid_sets[data_idx - 1]
+            )
+            ret = feval(scores.reshape(-1), ds)
+            if ret is not None:
+                if isinstance(ret, list):
+                    for n_, v_, b_ in ret:
+                        out.append((name, n_, v_, b_))
+                else:
+                    n_, v_, b_ = ret
+                    out.append((name, n_, v_, b_))
+        return out
+
+    # -------------------------------------------------------------- predict
+    def predict(
+        self,
+        data,
+        num_iteration: int = -1,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        data_has_header: bool = False,
+        is_reshape: bool = True,
+    ):
+        """Prediction on raw (unbinned) features; ``data`` may be a matrix
+        or a text file path (basic.py:259-448 semantics)."""
+        if self.best_iteration > 0 and num_iteration <= 0:
+            num_iteration = self.best_iteration
+        if isinstance(data, str):
+            from .io.parser import parse_file
+
+            raw, _ = parse_file(data, has_header=data_has_header)
+            label_idx = self._gbdt.label_idx
+            if raw.shape[1] > self._gbdt.max_feature_idx + 1:
+                data = np.delete(raw, label_idx, axis=1)
+            else:
+                data = raw
+        X = _densify(data)
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(X, num_iteration)
+        if raw_score:
+            return self._gbdt.predict_raw_score(X, num_iteration)
+        return self._gbdt.predict(X, num_iteration)
+
+    # ----------------------------------------------------------------- save
+    def save_model(self, filename: str, num_iteration: int = -1) -> None:
+        if num_iteration <= 0:
+            num_iteration = self.best_iteration
+        self._gbdt.save_model_to_file(filename, num_iteration)
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        if num_iteration <= 0:
+            num_iteration = self.best_iteration
+        return self._gbdt.save_model_to_string(num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> Dict[str, Any]:
+        """JSON-style dict dump (gbdt.cpp:438-477)."""
+        if num_iteration <= 0:
+            num_iteration = self.best_iteration
+        return self._gbdt.dump_model(num_iteration)
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        imp = self._gbdt.feature_importance_array(importance_type)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees
+
+    # --------------------------------------------------------------- pickle
+    def __getstate__(self):
+        """Pickle via model-string round trip (basic.py:1360)."""
+        state = {
+            "params": self.params,
+            "best_iteration": self.best_iteration,
+            "model_str": self._gbdt.save_model_to_string(-1),
+        }
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state["best_iteration"]
+        self._train_dataset = None
+        self.name_valid_sets = []
+        self._feval_metric_cache = {}
+        self._init_from_string(state["model_str"])
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        out = Booster(model_str=self._gbdt.save_model_to_string(-1),
+                      params=copy.deepcopy(self.params))
+        out.best_iteration = self.best_iteration
+        return out
+
+
+class _DatasetView:
+    """Minimal Dataset-like wrapper handed to custom fobj/feval for valid
+    sets (exposes get_label/get_weight/get_field like the reference)."""
+
+    def __init__(self, inner: BinnedDataset):
+        self._inner = inner
+
+    def get_label(self):
+        return self._inner.metadata.label
+
+    def get_weight(self):
+        return self._inner.metadata.weights
+
+    def get_field(self, name):
+        return self._inner.metadata.get_field(name)
+
+    def num_data(self):
+        return self._inner.num_data

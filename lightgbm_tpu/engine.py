@@ -1,0 +1,287 @@
+"""Training and cross-validation entry points.
+
+Mirrors the reference engine.py: ``train()`` (engine.py:12-194) translates
+keyword conveniences into callbacks and runs the boosting loop; ``cv()``
+(engine.py:197-399) runs k-fold (stratified when classifying) CV with
+mean/std aggregation.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import callback
+from .basic import Booster, Dataset, LightGBMError
+from .config import key_alias_transform
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[List[Dataset]] = None,
+    valid_names: Optional[List[str]] = None,
+    fobj: Optional[Callable] = None,
+    feval: Optional[Callable] = None,
+    init_model=None,
+    feature_name: Optional[List[str]] = None,
+    categorical_feature: Optional[List[int]] = None,
+    early_stopping_rounds: Optional[int] = None,
+    evals_result: Optional[dict] = None,
+    verbose_eval=True,
+    learning_rates=None,
+    callbacks: Optional[List[Callable]] = None,
+) -> Booster:
+    """Train a booster (reference engine.py:12-194)."""
+    params = key_alias_transform(dict(params))
+    if fobj is not None:
+        params["objective"] = "none"
+    if feature_name is not None:
+        train_set.feature_name = feature_name
+    if categorical_feature is not None:
+        train_set.categorical_feature = list(categorical_feature)
+    if isinstance(init_model, str):
+        params["input_model"] = init_model
+    elif isinstance(init_model, Booster):
+        params["input_model"] = ""
+
+    # merge dataset params so max_bin etc. flow through
+    merged = dict(train_set.params or {})
+    merged.update(params)
+    train_set.params = merged
+
+    booster = Booster(params=merged, train_set=train_set)
+    if isinstance(init_model, Booster):
+        booster._gbdt.merge_from(init_model._gbdt, prepend=True)
+    init_iteration = booster._gbdt.num_init_iteration
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    is_valid_contain_train = False
+    train_data_name = "training"
+    for i, vs in enumerate(valid_sets):
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs is train_set:
+            is_valid_contain_train = True
+            train_data_name = name
+            continue
+        if vs.reference is None:
+            vs.reference = train_set
+        booster.add_valid(vs, name)
+
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback.early_stopping(early_stopping_rounds, verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        cbs.add(callback.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback.record_evaluation(evals_result))
+
+    callbacks_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+
+    finished_early = False
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in callbacks_before:
+            cb(callback.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=init_iteration,
+                end_iteration=init_iteration + num_boost_round,
+                evaluation_result_list=None,
+            ))
+        is_finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets or is_valid_contain_train:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=init_iteration,
+                    end_iteration=init_iteration + num_boost_round,
+                    evaluation_result_list=evaluation_result_list,
+                ))
+        except callback.EarlyStopException:
+            finished_early = True
+            break
+        if is_finished:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = -1
+    return booster
+
+
+class CVBooster:
+    """Auxiliary container keeping all fold boosters (engine.py:197-230)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict[str, Any],
+                  seed: int, stratified: bool, shuffle: bool):
+    """engine.py:233-263: fold index generation (query-granular for ranking,
+    stratified for classification when asked)."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    group = full_data.get_field("group")
+    rng = np.random.RandomState(seed)
+    folds = []
+    if group is not None:
+        qb = np.asarray(group)
+        nq = len(qb) - 1
+        perm = rng.permutation(nq) if shuffle else np.arange(nq)
+        for k in range(nfold):
+            test_q = perm[k::nfold]
+            mask = np.zeros(num_data, bool)
+            for q in test_q:
+                mask[qb[q]:qb[q + 1]] = True
+            folds.append((np.nonzero(~mask)[0], np.nonzero(mask)[0]))
+    elif stratified:
+        label = np.asarray(full_data.get_label())
+        idx_by_class = [np.nonzero(label == c)[0] for c in np.unique(label)]
+        test_sets = [[] for _ in range(nfold)]
+        for idx in idx_by_class:
+            perm = rng.permutation(idx) if shuffle else idx
+            for k in range(nfold):
+                test_sets[k].append(perm[k::nfold])
+        for k in range(nfold):
+            test_idx = np.sort(np.concatenate(test_sets[k]))
+            mask = np.zeros(num_data, bool)
+            mask[test_idx] = True
+            folds.append((np.nonzero(~mask)[0], test_idx))
+    else:
+        perm = rng.permutation(num_data) if shuffle else np.arange(num_data)
+        for k in range(nfold):
+            test_idx = np.sort(perm[k::nfold])
+            mask = np.zeros(num_data, bool)
+            mask[test_idx] = True
+            folds.append((np.nonzero(~mask)[0], test_idx))
+    return folds
+
+
+def _agg_cv_result(raw_results):
+    """Mean/std across folds (engine.py:266-280)."""
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [
+        ("cv_agg", k, float(np.mean(v)), metric_type[k], float(np.std(v)))
+        for k, v in cvmap.items()
+    ]
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 10,
+    nfold: int = 5,
+    stratified: bool = False,
+    shuffle: bool = True,
+    metrics: Optional[List[str]] = None,
+    fobj: Optional[Callable] = None,
+    feval: Optional[Callable] = None,
+    init_model=None,
+    feature_name=None,
+    categorical_feature=None,
+    early_stopping_rounds: Optional[int] = None,
+    fpreproc: Optional[Callable] = None,
+    verbose_eval=None,
+    show_stdv: bool = True,
+    seed: int = 0,
+    callbacks: Optional[List[Callable]] = None,
+) -> Dict[str, List[float]]:
+    """K-fold cross validation (engine.py:283-399).  Returns the eval
+    history dict {"<name>-mean": [...], "<name>-stdv": [...]}."""
+    params = key_alias_transform(dict(params))
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics:
+        params["metric"] = metrics
+    if isinstance(init_model, str):
+        params["input_model"] = init_model
+
+    full_data = train_set
+    full_data.construct()
+    folds = _make_n_folds(full_data, nfold, params, seed, stratified, shuffle)
+
+    cvfolds = CVBooster()
+    for train_idx, test_idx in folds:
+        tr = full_data.subset(np.sort(train_idx))
+        te = full_data.subset(np.sort(test_idx))
+        tparams = dict(params)
+        if fpreproc is not None:
+            tr, te, tparams = fpreproc(tr, te, tparams.copy())
+        tr.params.update(tparams)
+        bst = Booster(params=tparams, train_set=tr)
+        bst.add_valid(te, "valid")
+        cvfolds.append(bst)
+
+    results = collections.defaultdict(list)
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback.early_stopping(early_stopping_rounds, verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback.print_evaluation(verbose_eval, show_stdv))
+    callbacks_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            for bst in cvfolds.boosters:
+                cb(callback.CallbackEnv(
+                    model=bst, params=params, iteration=i, begin_iteration=0,
+                    end_iteration=num_boost_round, evaluation_result_list=None,
+                ))
+        fold_results = []
+        for bst in cvfolds.boosters:
+            bst.update(fobj=fobj)
+            fold_results.append(bst.eval_valid(feval))
+        res = _agg_cv_result(fold_results)
+        for _, key, mean, _, std in res:
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+        try:
+            for cb in callbacks_after:
+                cb(callback.CallbackEnv(
+                    model=cvfolds, params=params, iteration=i, begin_iteration=0,
+                    end_iteration=num_boost_round, evaluation_result_list=res,
+                ))
+        except callback.EarlyStopException as e:
+            cvfolds.best_iteration = e.best_iteration + 1
+            for key in list(results):
+                results[key] = results[key][: e.best_iteration + 1]
+            break
+    return dict(results)

@@ -339,14 +339,8 @@ class GBDT:
 
     # ------------------------------------------------------------- model text
     def feature_importance(self) -> Dict[str, int]:
-        """Split-count importance (gbdt.cpp:594-619)."""
-        imp = np.zeros(self.max_feature_idx + 1, np.int64)
-        for tree in self.models:
-            nl = int(tree.num_leaves)
-            sfr = np.asarray(tree.split_feature_real)[: nl - 1]
-            for f in sfr:
-                if f >= 0:
-                    imp[f] += 1
+        """Split-count importance keyed by name (gbdt.cpp:594-619)."""
+        imp = self.feature_importance_array("split")
         names = self.feature_names or [
             f"Column_{i}" for i in range(self.max_feature_idx + 1)
         ]
@@ -415,6 +409,118 @@ class GBDT:
         self.num_init_iteration = len(self.models) // max(self.num_class, 1)
         self.iter_ = 0
 
+    def merge_from(self, other: "GBDT", prepend: bool = False) -> None:
+        """GBDT::MergeFrom (gbdt.h:44-61): concatenate another model's
+        trees.  ``prepend=True`` puts the other model first (continued
+        training from ``input_model``, gbdt.cpp:589-592) and replays its
+        predictions into the current train/valid scores."""
+        if other.num_class != self.num_class:
+            raise ValueError("cannot merge models with different num_class")
+        K = self.num_class
+        if prepend:
+            self.models = list(other.models) + self.models
+            self.num_init_iteration = len(other.models) // K
+            # replay other's trees into live scores (init_score seeding,
+            # application.cpp:110-115): raw-space traversal since loaded
+            # trees carry only real thresholds
+            if self.train_set is not None and other.models:
+                train_bins = self._bins_T.T
+                for i, tree in enumerate(other.models):
+                    k = i % K
+                    delta = self._replay_tree(tree, train_bins)
+                    self._scores = self._scores.at[k].add(delta)
+                    for vi in range(len(self.valid_sets)):
+                        self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
+                            self._replay_tree(tree, self._valid_bins[vi])
+                        )
+        else:
+            self.models = self.models + list(other.models)
+        self.iter_ = len(self.models) // K - self.num_init_iteration
+
+    def _replay_tree(self, tree: Tree, X_bin) -> jax.Array:
+        """Predict a tree from another model on our row-major binned matrix
+        by mapping its real-valued thresholds into THIS dataset's bin space.
+
+        The tree's own bin-space fields are never trusted — they belong to
+        whatever dataset the tree was trained on.  Only threshold_real /
+        split_feature_real (the raw-value decision program the reference
+        also uses for loaded models, tree.h:226-238) are consulted.
+        """
+        nl = int(tree.num_leaves)
+        if nl <= 1:
+            return jnp.zeros(X_bin.shape[0], jnp.float32)
+        sf = np.asarray(tree.split_feature_real)
+        tr = np.asarray(tree.threshold_real)
+        dt = np.asarray(tree.decision_type)
+        num_bins = self._num_bins
+        tb = np.zeros(tree.threshold_bin.shape, np.int32)
+        sf_inner = np.zeros(sf.shape, np.int32)
+        dt2 = dt.copy()
+        for i in range(nl - 1):
+            f_real = int(sf[i])
+            if f_real < 0:
+                continue
+            inner = int(self.train_set.used_feature_map[f_real])
+            if inner < 0:
+                # feature is trivial (constant) here: we cannot evaluate
+                # const <=/== threshold without the raw value, so force a
+                # deterministic all-left route via an impossible-to-fail
+                # numerical compare (bin <= num_bins)
+                sf_inner[i] = 0
+                tb[i] = num_bins
+                dt2[i] = 0
+                continue
+            sf_inner[i] = inner
+            mapper = self.train_set.bin_mappers[inner]
+            if dt[i] == 1:  # categorical: threshold is the category id
+                tb[i] = mapper.category_to_bin.get(int(tr[i]), num_bins)
+            else:
+                # threshold_real == bounds[threshold_bin]; recover the bin
+                # as the first bound >= t (tolerating text-format fp noise)
+                bounds = self._bin_thresholds[inner]
+                eps = abs(tr[i]) * 1e-9 + 1e-12
+                tb[i] = min(int(np.searchsorted(bounds, tr[i] - eps)), len(bounds) - 1)
+        t2 = tree._replace(
+            split_feature=jnp.asarray(sf_inner),
+            threshold_bin=jnp.asarray(tb),
+            decision_type=jnp.asarray(dt2),
+        )
+        return predict_binned(t2, X_bin)
+
+    # ------------------------------------------------------------ JSON dump
+    def dump_model(self, num_iteration: int = -1) -> Dict:
+        """GBDT::DumpModel (gbdt.cpp:438-477): JSON-style dict."""
+        names = self.feature_names or [
+            f"Column_{i}" for i in range(self.max_feature_idx + 1)
+        ]
+        num_used = len(self.models)
+        if num_iteration > 0:
+            num_used = min(num_iteration * self.num_class, num_used)
+        return {
+            "name": self.name,
+            "num_class": self.num_class,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": self.objective_name(),
+            "sigmoid": self.sigmoid,
+            "feature_names": names,
+            "tree_info": [
+                _tree_to_json(self.models[i], i) for i in range(num_used)
+            ],
+        }
+
+    def feature_importance_array(self, importance_type: str = "split") -> np.ndarray:
+        """Importances as an array over all original columns."""
+        imp = np.zeros(self.max_feature_idx + 1, np.float64)
+        for tree in self.models:
+            nl = int(tree.num_leaves)
+            sfr = np.asarray(tree.split_feature_real)[: nl - 1]
+            gains = np.asarray(tree.split_gain)[: nl - 1]
+            for j, f in enumerate(sfr):
+                if f >= 0:
+                    imp[f] += gains[j] if importance_type == "gain" else 1
+        return imp
+
     @property
     def num_trees(self) -> int:
         return len(self.models)
@@ -457,6 +563,53 @@ def _tree_to_string(tree: Tree) -> str:
     )
     out.append("")
     return "\n".join(out)
+
+
+def _tree_to_json(tree: Tree, index: int) -> Dict:
+    """Tree::ToJSON (tree.cpp:153-191): recursive node dict."""
+    nl = int(tree.num_leaves)
+    sf = np.asarray(tree.split_feature_real)
+    sg = np.asarray(tree.split_gain)
+    tr = np.asarray(tree.threshold_real)
+    dt = np.asarray(tree.decision_type)
+    lc = np.asarray(tree.left_child)
+    rc = np.asarray(tree.right_child)
+    iv = np.asarray(tree.internal_value)
+    ic = np.asarray(tree.internal_count)
+    lv = np.asarray(tree.leaf_value)
+    lcnt = np.asarray(tree.leaf_count)
+    lp = np.asarray(tree.leaf_parent)
+
+    def leaf_node(leaf: int) -> Dict:
+        return {
+            "leaf_index": int(leaf),
+            "leaf_parent": int(lp[leaf]),
+            "leaf_value": float(lv[leaf]),
+            "leaf_count": int(lcnt[leaf]),
+        }
+
+    # children are always created after their parent (tree.cpp:52-96), so a
+    # reverse sweep builds every child dict before its parent — no recursion
+    built: Dict[int, Dict] = {}
+    for i in range(nl - 2, -1, -1):
+        li, ri = int(lc[i]), int(rc[i])
+        built[i] = {
+            "split_index": int(i),
+            "split_feature": int(sf[i]),
+            "split_gain": float(sg[i]),
+            "threshold": float(tr[i]),
+            "decision_type": "==" if dt[i] == 1 else "<=",
+            "internal_value": float(iv[i]),
+            "internal_count": int(ic[i]),
+            "left_child": built[li] if li >= 0 else leaf_node(~li),
+            "right_child": built[ri] if ri >= 0 else leaf_node(~ri),
+        }
+
+    return {
+        "tree_index": index,
+        "num_leaves": nl,
+        "tree_structure": built[0] if nl > 1 else leaf_node(0),
+    }
 
 
 def _tree_from_lines(lines: List[str]) -> Tree:

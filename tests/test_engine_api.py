@@ -1,0 +1,218 @@
+"""Python API tests: Dataset/Booster/train/cv/callbacks.
+
+Models the reference's python engine tests
+(tests/python_package_test/test_engine.py): accuracy-threshold training,
+early stopping, custom fobj/feval, continued training, save/load/pickle
+prediction equivalence, cv().
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_binary(n=2000, f=10, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    w = rng.randn(f)
+    y = (X @ w + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def make_regression(n=2000, f=10, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    w = rng.randn(f)
+    y = X @ w + 0.5 * (X[:, 0] * X[:, 1]) + 0.1 * rng.randn(n)
+    return X, y
+
+
+PARAMS = {
+    "objective": "binary",
+    "metric": "binary_logloss",
+    "num_leaves": 15,
+    "min_data_in_leaf": 20,
+    "min_sum_hessian_in_leaf": 1.0,
+    "verbose": 0,
+}
+
+
+def test_train_binary_accuracy():
+    X, y = make_binary()
+    Xtr, ytr, Xte, yte = X[:1500], y[:1500], X[1500:], y[1500:]
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = train.create_valid(Xte, label=yte)
+    evals = {}
+    bst = lgb.train(
+        PARAMS, train, num_boost_round=50, valid_sets=[valid],
+        valid_names=["eval"], evals_result=evals, verbose_eval=False,
+    )
+    assert evals["eval"]["binary_logloss"][-1] < 0.25
+    pred = bst.predict(Xte)
+    err = np.mean((pred > 0.5) != yte)
+    assert err < 0.12
+
+
+def test_early_stopping_and_best_iteration():
+    X, y = make_binary(1200)
+    train = lgb.Dataset(X[:800], label=y[:800])
+    valid = train.create_valid(X[800:], label=y[800:])
+    bst = lgb.train(
+        {**PARAMS, "learning_rate": 0.5, "num_leaves": 63, "min_data_in_leaf": 5},
+        train, num_boost_round=200, valid_sets=[valid],
+        early_stopping_rounds=5, verbose_eval=False,
+    )
+    assert 0 < bst.best_iteration < 200
+    # predict() uses best_iteration by default
+    p_best = bst.predict(X[800:])
+    p_explicit = bst.predict(X[800:], num_iteration=bst.best_iteration)
+    np.testing.assert_allclose(p_best, p_explicit)
+
+
+def test_save_load_string_pickle_equivalence(tmp_path):
+    X, y = make_binary(800)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(PARAMS, train, num_boost_round=20, verbose_eval=False)
+    pred = bst.predict(X)
+
+    # file round trip
+    path = os.path.join(tmp_path, "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst2.predict(X, raw_score=True),
+                               bst.predict(X, raw_score=True), atol=1e-5)
+    # sigmoid transform survives load (objective recorded in the model file)
+    np.testing.assert_allclose(bst2.predict(X), pred, atol=1e-5)
+
+    # string round trip
+    bst3 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst3.predict(X), pred, atol=1e-5)
+
+    # pickle round trip (reference test_engine.py save/load/copy/pickle)
+    blob = pickle.dumps(bst)
+    bst4 = pickle.loads(blob)
+    np.testing.assert_allclose(bst4.predict(X), pred, atol=1e-5)
+
+
+def test_dump_model_json():
+    X, y = make_binary(500)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=3,
+                    verbose_eval=False)
+    dump = bst.dump_model()
+    assert dump["num_class"] == 1
+    assert len(dump["tree_info"]) == 3
+    root = dump["tree_info"][0]["tree_structure"]
+    assert "split_feature" in root and "left_child" in root
+    import json
+
+    json.dumps(dump)  # must be JSON-serializable
+
+
+def test_custom_fobj_feval():
+    X, y = make_regression()
+    train = lgb.Dataset(X, label=y, params={"verbose": 0})
+
+    def l2_obj(preds, dataset):
+        grad = preds - dataset.get_label()
+        hess = np.ones_like(grad)
+        return grad, hess
+
+    def rmse_feval(preds, dataset):
+        return "custom_rmse", float(np.sqrt(np.mean((preds - dataset.get_label()) ** 2))), False
+
+    evals = {}
+    bst = lgb.train(
+        {"num_leaves": 15, "min_data_in_leaf": 20, "metric": "l2",
+         "min_sum_hessian_in_leaf": 1.0, "verbose": 0},
+        train, num_boost_round=30, fobj=l2_obj, feval=rmse_feval,
+        valid_sets=[train], valid_names=["training"],
+        evals_result=evals, verbose_eval=False,
+    )
+    assert evals["training"]["custom_rmse"][-1] < evals["training"]["custom_rmse"][0]
+    # custom-objective model predicts sensibly
+    pred = bst.predict(X, raw_score=True)
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+def test_continued_training_init_model(tmp_path):
+    X, y = make_binary(1000)
+    train = lgb.Dataset(X, label=y)
+    bst1 = lgb.train(PARAMS, train, num_boost_round=10, verbose_eval=False)
+    path = os.path.join(tmp_path, "m1.txt")
+    bst1.save_model(path)
+
+    # continue from file
+    train2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train(PARAMS, train2, num_boost_round=10, init_model=path,
+                     verbose_eval=False)
+    assert bst2.num_trees() == 20
+    # continued model beats the starting model on train logloss
+    def logloss(p):
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+    assert logloss(bst2.predict(X)) < logloss(bst1.predict(X))
+
+    # continue from in-memory Booster
+    train3 = lgb.Dataset(X, label=y)
+    bst3 = lgb.train(PARAMS, train3, num_boost_round=10, init_model=bst1,
+                     verbose_eval=False)
+    assert bst3.num_trees() == 20
+
+
+def test_reset_parameter_learning_rates():
+    X, y = make_binary(800)
+    train = lgb.Dataset(X, label=y)
+    seen = []
+
+    def spy(env):
+        seen.append(env.model.config.learning_rate)
+
+    spy.order = 99
+    bst = lgb.train(
+        PARAMS, train, num_boost_round=5,
+        learning_rates=lambda it: 0.2 * (0.5 ** it),
+        callbacks=[spy], verbose_eval=False,
+    )
+    np.testing.assert_allclose(seen, [0.2 * 0.5 ** i for i in range(5)])
+
+
+def test_cv_binary():
+    X, y = make_binary(1000)
+    train = lgb.Dataset(X, label=y)
+    res = lgb.cv(PARAMS, train, num_boost_round=10, nfold=3, stratified=True,
+                 seed=42, verbose_eval=False)
+    key = "valid binary_logloss-mean"
+    assert key in res and len(res[key]) == 10
+    assert res[key][-1] < res[key][0]
+    assert all(s >= 0 for s in res["valid binary_logloss-stdv"])
+
+
+def test_rollback_and_update_api():
+    X, y = make_binary(600)
+    bst = lgb.Booster(params=PARAMS, train_set=lgb.Dataset(X, label=y))
+    for _ in range(3):
+        bst.update()
+    assert bst.current_iteration == 3
+    bst.rollback_one_iter()
+    assert bst.current_iteration == 2
+
+
+def test_dataset_fields_and_binary(tmp_path):
+    X, y = make_binary(400)
+    w = np.abs(np.random.RandomState(0).randn(400)) + 0.1
+    ds = lgb.Dataset(X, label=y, weight=w)
+    assert ds.num_data() == 400
+    assert ds.num_feature() == 10
+    np.testing.assert_allclose(ds.get_weight(), w.astype(np.float32), rtol=1e-6)
+    path = os.path.join(tmp_path, "ds.bin")
+    ds.save_binary(path)
+    from lightgbm_tpu.io.dataset import BinnedDataset
+
+    back = BinnedDataset.load_binary(path)
+    assert back.num_data == 400
+    np.testing.assert_array_equal(back.X_bin, ds.construct().X_bin)

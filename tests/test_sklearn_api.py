@@ -1,0 +1,193 @@
+"""sklearn-wrapper tests modeled on the reference's
+tests/python_package_test/test_sklearn.py: binary / regression /
+multiclass / lambdarank accuracy, custom objective/eval, dart mode,
+clone & grid search, joblib/pickle persistence.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_blobs(n=1200, f=8, classes=3, seed=11):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, f) * 3
+    y = rng.randint(0, classes, size=n)
+    X = centers[y] + rng.randn(n, f)
+    return X, y.astype(np.float64)
+
+
+COMMON = dict(n_estimators=30, num_leaves=15, min_child_samples=10,
+              min_child_weight=1.0)
+
+
+def test_classifier_binary():
+    rng = np.random.RandomState(2)
+    X = rng.randn(1500, 10)
+    y = (X @ rng.randn(10) > 0).astype(int)
+    clf = lgb.LGBMClassifier(**COMMON).fit(X[:1000], y[:1000])
+    acc = np.mean(clf.predict(X[1000:]) == y[1000:])
+    assert acc > 0.85
+    proba = clf.predict_proba(X[1000:])
+    assert proba.shape == (500, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_classifier_multiclass():
+    X, y = make_blobs()
+    clf = lgb.LGBMClassifier(**COMMON).fit(X[:900], y[:900])
+    assert clf.n_classes_ == 3
+    acc = np.mean(clf.predict(X[900:]) == y[900:])
+    assert acc > 0.85
+    proba = clf.predict_proba(X[900:])
+    assert proba.shape == (300, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_classifier_string_labels():
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 6)
+    y = np.where(X[:, 0] + 0.2 * rng.randn(600) > 0, "pos", "neg")
+    clf = lgb.LGBMClassifier(**COMMON).fit(X, y)
+    pred = clf.predict(X)
+    assert set(pred) <= {"pos", "neg"}
+    assert np.mean(pred == y) > 0.9
+
+
+def test_regressor():
+    rng = np.random.RandomState(7)
+    X = rng.randn(1500, 10)
+    y = X @ rng.randn(10) + 0.1 * rng.randn(1500)
+    reg = lgb.LGBMRegressor(**{**COMMON, "n_estimators": 50})
+    reg.fit(X[:1000], y[:1000])
+    pred = reg.predict(X[1000:])
+    rmse = np.sqrt(np.mean((pred - y[1000:]) ** 2))
+    assert rmse < 0.6 * y.std()
+
+
+def test_regressor_eval_set_early_stop():
+    rng = np.random.RandomState(9)
+    X = rng.randn(1200, 8)
+    y = X @ rng.randn(8)
+    reg = lgb.LGBMRegressor(**{**COMMON, "n_estimators": 100, "learning_rate": 0.3})
+    reg.fit(X[:800], y[:800], eval_set=[(X[800:], y[800:])],
+            eval_metric=["l2"], early_stopping_rounds=5)
+    assert "valid_0" in reg.evals_result_
+    assert "l2" in reg.evals_result_["valid_0"]
+
+
+def test_ranker_ndcg():
+    # synthetic ranking: 60 queries x 20 docs, label 0-4 correlated with features
+    rng = np.random.RandomState(13)
+    nq, per = 60, 20
+    X = rng.randn(nq * per, 6)
+    rel = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(nq * per)
+    y = np.zeros(nq * per)
+    for q in range(nq):
+        seg = slice(q * per, (q + 1) * per)
+        ranks = np.argsort(np.argsort(rel[seg]))
+        y[seg] = np.clip((ranks / per * 5).astype(int), 0, 4)
+    group = np.full(nq, per)
+    rk = lgb.LGBMRanker(**{**COMMON, "min_child_samples": 5})
+    rk.fit(X, y, group=group)
+    # NDCG@3 on training data must be high (reference asserts > 0.8)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dcg import dcg_at_k, max_dcg_at_k, label_gains_from_config
+
+    gains = label_gains_from_config([])
+    scores = rk.predict(X, raw_score=True)
+    accs = []
+    for q in range(nq):
+        seg = slice(q * per, (q + 1) * per)
+        order = np.argsort(-scores[seg], kind="stable")
+        m = max_dcg_at_k(3, y[seg], gains)
+        if m > 0:
+            accs.append(dcg_at_k(3, y[seg][order], gains) / m)
+    assert np.mean(accs) > 0.8
+
+
+def test_ranker_requires_group():
+    X = np.random.randn(50, 3)
+    y = np.random.randint(0, 2, 50)
+    with pytest.raises(lgb.LightGBMError):
+        lgb.LGBMRanker().fit(X, y)
+
+
+def test_custom_objective_sklearn():
+    rng = np.random.RandomState(17)
+    X = rng.randn(800, 6)
+    y = X @ rng.randn(6)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = lgb.LGBMRegressor(**{**COMMON, "objective": l2_obj, "n_estimators": 40})
+    reg.fit(X, y)
+    pred = reg.predict(X, raw_score=True)
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+def test_dart_mode():
+    rng = np.random.RandomState(19)
+    X = rng.randn(800, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    clf = lgb.LGBMClassifier(**{**COMMON, "boosting_type": "dart"})
+    clf.fit(X, y)
+    assert np.mean(clf.predict(X) == y) > 0.85
+
+
+def test_clone_and_get_params():
+    clf = lgb.LGBMClassifier(num_leaves=7, learning_rate=0.2)
+    params = clf.get_params()
+    assert params["num_leaves"] == 7 and params["learning_rate"] == 0.2
+    clone = lgb.LGBMClassifier(**params)
+    assert clone.get_params() == params
+    clone.set_params(num_leaves=31)
+    assert clone.get_params()["num_leaves"] == 31
+
+
+def test_sklearn_integration_clone_cv():
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.base import clone
+    from sklearn.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(23)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(**{**COMMON, "n_estimators": 10})
+    c2 = clone(clf)
+    c2.fit(X, y)
+    gs = GridSearchCV(
+        lgb.LGBMClassifier(n_estimators=5, min_child_samples=5, min_child_weight=1.0),
+        {"num_leaves": [7, 15]}, cv=2, scoring="accuracy",
+    )
+    gs.fit(X, y)
+    assert gs.best_params_["num_leaves"] in (7, 15)
+
+
+def test_pickle_fitted_estimator():
+    rng = np.random.RandomState(29)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(**{**COMMON, "n_estimators": 10}).fit(X, y)
+    blob = pickle.dumps(clf)
+    back = pickle.loads(blob)
+    np.testing.assert_allclose(back.predict_proba(X), clf.predict_proba(X), atol=1e-6)
+    assert np.all(back.classes_ == clf.classes_)
+
+
+def test_feature_importances():
+    rng = np.random.RandomState(31)
+    X = rng.randn(600, 5)
+    y = (X[:, 2] > 0).astype(int)  # only feature 2 matters
+    clf = lgb.LGBMClassifier(**{**COMMON, "n_estimators": 10}).fit(X, y)
+    imp = clf.feature_importances_
+    assert imp.shape == (5,)
+    assert imp[2] > 0
+    # split counts can favor noise features once leaves are pure (tie-break
+    # goes to the smallest feature index); gain importance is unambiguous
+    gain = clf.booster_.feature_importance(importance_type="gain")
+    assert np.argmax(gain) == 2
