@@ -197,10 +197,6 @@ class Dataset:
         return self.construct().num_total_features
 
 
-def _is_finished_name(name: str) -> str:
-    return name
-
-
 class Booster:
     """The boosting model (reference basic.py:1276-1819).
 
@@ -219,7 +215,7 @@ class Booster:
         self.best_iteration = -1
         self._train_dataset: Optional[Dataset] = None
         self.name_valid_sets: List[str] = []
-        self._feval_metric_cache: Dict[int, List[Metric]] = {}
+        self.train_data_name = "training"
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise LightGBMError("Training data should be Dataset instance")
@@ -323,7 +319,7 @@ class Booster:
         return self.__eval_at(data_idx, name, feval)
 
     def eval_train(self, feval=None):
-        return self.__eval_at(0, "training", feval)
+        return self.__eval_at(0, self.train_data_name, feval)
 
     def eval_valid(self, feval=None):
         out = []
@@ -433,7 +429,7 @@ class Booster:
         self.best_iteration = state["best_iteration"]
         self._train_dataset = None
         self.name_valid_sets = []
-        self._feval_metric_cache = {}
+        self.train_data_name = "training"
         self._init_from_string(state["model_str"])
 
     def __copy__(self):

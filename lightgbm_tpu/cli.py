@@ -1,0 +1,209 @@
+"""Command-line application: ``python -m lightgbm_tpu config=train.conf``.
+
+Mirrors the reference Application (src/application/application.cpp,
+src/main.cpp): ``key=value`` argv merged over a config file (argv wins,
+application.cpp:46-104), then Train (application.cpp:187-239) — data
+load, boosting/objective construction, per-iteration timing log, metric
+output every ``metric_freq``, early stopping, model save — or Predict
+(application.cpp:242-256) via the batch :class:`Predictor`.
+
+Reference ``examples/*/train.conf`` files parse and run unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config, parse_config_file, parse_line_params
+from .io.dataset import BinnedDataset
+from .log import Log
+from .models.dart import create_boosting
+from .models.gbdt import GBDT
+from .objectives import create_objective
+
+
+def load_parameters(argv: List[str]) -> Dict[str, str]:
+    """argv ``key=value`` pairs + optional config file; argv wins
+    (application.cpp:46-104)."""
+    params = parse_line_params(argv)
+    conf_path = params.get("config", params.get("config_file", ""))
+    if conf_path:
+        file_params = parse_config_file(conf_path)
+        for k, v in file_params.items():
+            params.setdefault(k, v)
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+class Predictor:
+    """Batch file prediction -> result file (src/application/predictor.hpp:
+    24-155): parse input rows, run normal/raw/leaf-index prediction,
+    write one line per row (tab-separated for multi-output)."""
+
+    def __init__(self, booster, is_raw_score: bool, is_predict_leaf_index: bool):
+        self.booster = booster
+        self.is_raw_score = is_raw_score
+        self.is_leaf = is_predict_leaf_index
+
+    def predict_file(self, data_path: str, result_path: str, has_header: bool = False) -> None:
+        from .basic import Booster
+
+        out = self.booster.predict(
+            data_path,
+            raw_score=self.is_raw_score,
+            pred_leaf=self.is_leaf,
+            data_has_header=has_header,
+        )
+        out = np.asarray(out)
+        with open(result_path, "w") as fh:
+            if out.ndim == 1:
+                for v in out:
+                    fh.write(f"{v:.9g}\n")
+            else:
+                for row in out:
+                    fh.write("\t".join(f"{v:.9g}" for v in row) + "\n")
+
+
+def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],
+                    is_training_metric: bool) -> List[tuple]:
+    """OutputMetric (gbdt.cpp:299-356): print + return (set_idx, metric,
+    value, bigger_is_better) rows for early-stopping bookkeeping."""
+    rows = []
+    sets = []
+    if is_training_metric:
+        sets.append((0, "training"))
+    sets.extend((i + 1, names[i]) for i in range(len(names)))
+    for data_idx, name in sets:
+        metrics = gbdt.train_metrics if data_idx == 0 else gbdt.valid_metrics[data_idx - 1]
+        scores = gbdt.predict_at(data_idx)
+        s = scores if gbdt.num_class > 1 else scores[0]
+        for m in metrics:
+            if hasattr(m, "eval_multi"):
+                for k, v in zip(m.eval_at, m.eval_multi(s)):
+                    Log.info(f"Iteration: {iter_num}, {name} {m.name}@{k} : {v:g}")
+                    if data_idx > 0:
+                        rows.append((data_idx, f"{m.name}@{k}", v, m.bigger_is_better))
+            else:
+                v = m.eval(s)
+                Log.info(f"Iteration: {iter_num}, {name} {m.name} : {v:g}")
+                if data_idx > 0:
+                    rows.append((data_idx, m.name, v, m.bigger_is_better))
+    return rows
+
+
+def run_train(cfg: Config) -> GBDT:
+    """InitTrain + Train (application.cpp:187-239)."""
+    t0 = time.perf_counter()
+    train = BinnedDataset.from_file(cfg.data, cfg)
+    Log.info(
+        f"Finish loading data, use {time.perf_counter() - t0:.6f} seconds"
+    )
+    objective = create_objective(cfg, train.metadata, train.num_data)
+    booster = create_boosting(cfg, train, objective)
+
+    valid_names: List[str] = []
+    for path in cfg.valid_data:
+        vset = BinnedDataset.from_file(path, cfg, reference=train)
+        name = os.path.basename(path)
+        booster.add_valid_dataset(vset, name)
+        valid_names.append(name)
+
+    if cfg.input_model:
+        from .basic import Booster
+
+        init = Booster(model_file=cfg.input_model)
+        booster.merge_from(init._gbdt, prepend=True)
+        Log.info(
+            f"Continued training from {cfg.input_model} "
+            f"({init._gbdt.num_trees} trees)"
+        )
+
+    # early-stopping state per (valid set, metric) (gbdt.cpp:336-347)
+    best_score: Dict[tuple, float] = {}
+    best_iter: Dict[tuple, int] = {}
+    best_model_iter = 0
+
+    start = time.perf_counter()
+    stop_early = False
+    for it in range(cfg.num_iterations):
+        finished = booster.train_one_iter()
+        Log.info(
+            f"{time.perf_counter() - start:.6f} seconds elapsed, "
+            f"finished iteration {it + 1}"
+        )
+        if cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0:
+            rows = _output_metrics(booster, it + 1, valid_names, cfg.is_training_metric)
+            if cfg.early_stopping_round > 0:
+                for data_idx, mname, v, bigger in rows:
+                    key = (data_idx, mname)
+                    better = (
+                        key not in best_score
+                        or (v > best_score[key] if bigger else v < best_score[key])
+                    )
+                    if better:
+                        best_score[key], best_iter[key] = v, it
+                if rows and all(
+                    it - best_iter[k] >= cfg.early_stopping_round for k in best_iter
+                ):
+                    best_model_iter = max(best_iter.values()) + 1
+                    Log.info(
+                        f"Early stopping at iteration {it + 1}, the best "
+                        f"iteration round is {best_model_iter}"
+                    )
+                    stop_early = True
+                    break
+        if finished:
+            Log.info("Stopped training because there are no more leaves "
+                     "that meet the split requirements.")
+            break
+
+    num_iteration = best_model_iter if stop_early else -1
+    booster.save_model_to_file(cfg.output_model, num_iteration)
+    Log.info(f"Finished training, saved model to {cfg.output_model}")
+    return booster
+
+
+def run_predict(cfg: Config) -> None:
+    """Application::Predict (application.cpp:242-256)."""
+    from .basic import Booster
+
+    if not cfg.input_model:
+        Log.fatal("input_model should not be empty for prediction task")
+    booster = Booster(model_file=cfg.input_model)
+    t0 = time.perf_counter()
+    Predictor(
+        booster, cfg.is_predict_raw_score, cfg.is_predict_leaf_index
+    ).predict_file(cfg.data, cfg.output_result, cfg.has_header)
+    Log.info(
+        f"Finish prediction, use {time.perf_counter() - t0:.6f} seconds; "
+        f"saved to {cfg.output_result}"
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """main.cpp:4-22."""
+    argv = sys.argv[1:] if argv is None else list(argv)
+    try:
+        params = load_parameters(argv)
+        cfg = Config.from_dict(params)
+        Log.reset_log_level(cfg.verbose)
+        if cfg.task == "train":
+            run_train(cfg)
+        elif cfg.task in ("predict", "prediction", "test"):
+            run_predict(cfg)
+        else:
+            Log.fatal(f"Unknown task: {cfg.task!r}")
+    except Exception as ex:
+        print(f"Met Exceptions:\n{ex}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,7 @@ def train(
         if vs is train_set:
             is_valid_contain_train = True
             train_data_name = name
+            booster.train_data_name = name
             continue
         if vs.reference is None:
             vs.reference = train_set
@@ -90,7 +91,6 @@ def train(
     callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
     callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
 
-    finished_early = False
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in callbacks_before:
             cb(callback.CallbackEnv(
@@ -115,7 +115,6 @@ def train(
                     evaluation_result_list=evaluation_result_list,
                 ))
         except callback.EarlyStopException:
-            finished_early = True
             break
         if is_finished:
             break
@@ -135,6 +134,9 @@ class CVBooster:
         self.boosters.append(booster)
 
     def __getattr__(self, name):
+        if name.startswith("_"):  # never fabricate dunder/private protocol hooks
+            raise AttributeError(name)
+
         def handler_function(*args, **kwargs):
             return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
 
@@ -145,13 +147,13 @@ def _make_n_folds(full_data: Dataset, nfold: int, params: Dict[str, Any],
                   seed: int, stratified: bool, shuffle: bool):
     """engine.py:233-263: fold index generation (query-granular for ranking,
     stratified for classification when asked)."""
-    full_data.construct()
+    inner = full_data.construct()
     num_data = full_data.num_data()
-    group = full_data.get_field("group")
+    qb = inner.metadata.query_boundaries
     rng = np.random.RandomState(seed)
     folds = []
-    if group is not None:
-        qb = np.asarray(group)
+    if qb is not None:
+        qb = np.asarray(qb)
         nq = len(qb) - 1
         perm = rng.permutation(nq) if shuffle else np.arange(nq)
         for k in range(nfold):
@@ -228,6 +230,10 @@ def cv(
         params["metric"] = metrics
     if isinstance(init_model, str):
         params["input_model"] = init_model
+    if feature_name is not None:
+        train_set.feature_name = feature_name
+    if categorical_feature is not None:
+        train_set.categorical_feature = list(categorical_feature)
 
     full_data = train_set
     full_data.construct()

@@ -83,7 +83,11 @@ class Metadata:
         if name == "init_score":
             return self.init_score
         if name in ("group", "query"):
-            return self.query_boundaries
+            # group SIZES, matching what callers set and what custom
+            # objectives expect; boundaries stay internal
+            if self.query_boundaries is None:
+                return None
+            return np.diff(self.query_boundaries)
         raise ValueError(f"Unknown field {name!r}")
 
     def subset(self, indices: np.ndarray) -> "Metadata":

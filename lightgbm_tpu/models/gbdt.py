@@ -417,29 +417,34 @@ class GBDT:
         if other.num_class != self.num_class:
             raise ValueError("cannot merge models with different num_class")
         K = self.num_class
+        incoming = list(other.models)
+        if self.train_set is not None:
+            # re-bind foreign trees into THIS dataset's bin space so every
+            # stored model is safe for predict_binned (valid-set replay in
+            # add_valid_dataset, score updates here)
+            incoming = [self._rebind_tree(t) for t in incoming]
         if prepend:
-            self.models = list(other.models) + self.models
-            self.num_init_iteration = len(other.models) // K
+            self.models = incoming + self.models
+            self.num_init_iteration = len(incoming) // K
             # replay other's trees into live scores (init_score seeding,
-            # application.cpp:110-115): raw-space traversal since loaded
-            # trees carry only real thresholds
-            if self.train_set is not None and other.models:
+            # application.cpp:110-115)
+            if self.train_set is not None and incoming:
                 train_bins = self._bins_T.T
-                for i, tree in enumerate(other.models):
+                for i, tree in enumerate(incoming):
                     k = i % K
-                    delta = self._replay_tree(tree, train_bins)
-                    self._scores = self._scores.at[k].add(delta)
+                    self._scores = self._scores.at[k].add(
+                        predict_binned(tree, train_bins)
+                    )
                     for vi in range(len(self.valid_sets)):
                         self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
-                            self._replay_tree(tree, self._valid_bins[vi])
+                            predict_binned(tree, self._valid_bins[vi])
                         )
         else:
-            self.models = self.models + list(other.models)
+            self.models = self.models + incoming
         self.iter_ = len(self.models) // K - self.num_init_iteration
 
-    def _replay_tree(self, tree: Tree, X_bin) -> jax.Array:
-        """Predict a tree from another model on our row-major binned matrix
-        by mapping its real-valued thresholds into THIS dataset's bin space.
+    def _rebind_tree(self, tree: Tree) -> Tree:
+        """Map a tree from another model into THIS dataset's bin space.
 
         The tree's own bin-space fields are never trusted — they belong to
         whatever dataset the tree was trained on.  Only threshold_real /
@@ -448,7 +453,7 @@ class GBDT:
         """
         nl = int(tree.num_leaves)
         if nl <= 1:
-            return jnp.zeros(X_bin.shape[0], jnp.float32)
+            return tree
         sf = np.asarray(tree.split_feature_real)
         tr = np.asarray(tree.threshold_real)
         dt = np.asarray(tree.decision_type)
@@ -480,12 +485,11 @@ class GBDT:
                 bounds = self._bin_thresholds[inner]
                 eps = abs(tr[i]) * 1e-9 + 1e-12
                 tb[i] = min(int(np.searchsorted(bounds, tr[i] - eps)), len(bounds) - 1)
-        t2 = tree._replace(
+        return tree._replace(
             split_feature=jnp.asarray(sf_inner),
             threshold_bin=jnp.asarray(tb),
             decision_type=jnp.asarray(dt2),
         )
-        return predict_binned(t2, X_bin)
 
     # ------------------------------------------------------------ JSON dump
     def dump_model(self, num_iteration: int = -1) -> Dict:

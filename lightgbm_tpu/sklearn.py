@@ -211,8 +211,11 @@ class LGBMModel(_SKLBase):
         feature_name=None,
         categorical_feature=None,
         callbacks=None,
+        _extra_params=None,
     ) -> "LGBMModel":
         params = self._to_inner_params()
+        if _extra_params:
+            params.update(_extra_params)
         if eval_metric is not None and not callable(eval_metric):
             params["metric"] = eval_metric
         fobj = _ObjectiveFunctionWrapper(self.objective) if callable(self.objective) else None
@@ -289,27 +292,22 @@ class LGBMClassifier(_SKLClassifierMixin, LGBMModel):
         y = np.asarray(y)
         self.classes_, y_enc = np.unique(y, return_inverse=True)
         self.n_classes_ = len(self.classes_)
-        params_obj = self.objective
-        if self.n_classes_ > 2 and not callable(params_obj):
-            self.objective = "multiclass"
-            kwargs_extra = {"num_class": self.n_classes_}
-        else:
-            kwargs_extra = {}
-        # stash num_class through params by temporarily patching
-        if kwargs_extra:
-            orig = self._to_inner_params
-
-            def patched():
-                p = orig()
-                p.update(kwargs_extra)
-                return p
-
-            self._to_inner_params = patched
-        try:
-            super().fit(X, y_enc.astype(np.float64), **kwargs)
-        finally:
-            if kwargs_extra:
-                self._to_inner_params = orig
+        extra = {}
+        if self.n_classes_ > 2 and not callable(self.objective):
+            # leave self.objective untouched (sklearn params are immutable
+            # across fits); route the override through fit-time params
+            extra = {"objective": "multiclass", "num_class": self.n_classes_}
+        # eval_set labels must go through the same encoding as y
+        eval_set = kwargs.get("eval_set")
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            encoded = []
+            for vx, vy in eval_set:
+                vy_enc = np.searchsorted(self.classes_, np.asarray(vy))
+                encoded.append((vx, vy_enc.astype(np.float64)))
+            kwargs["eval_set"] = encoded
+        super().fit(X, y_enc.astype(np.float64), _extra_params=extra, **kwargs)
         return self
 
     def predict(self, X, raw_score: bool = False, num_iteration: int = -1):

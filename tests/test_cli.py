@@ -1,0 +1,123 @@
+"""CLI application tests: reference example configs run unchanged
+(examples/*/train.conf + predict.conf, per application.cpp semantics)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import load_parameters, main
+
+
+@pytest.fixture()
+def in_example_dir(reference_examples, tmp_path, monkeypatch):
+    """Run inside the reference example dir (its confs use relative paths)
+    with outputs redirected to tmp."""
+
+    def enter(name):
+        monkeypatch.chdir(os.path.join(reference_examples, name))
+        return tmp_path
+
+    return enter
+
+
+def test_load_parameters_precedence(tmp_path):
+    conf = tmp_path / "t.conf"
+    conf.write_text("num_trees = 100\nlearning_rate = 0.1\n# comment\n")
+    params = load_parameters([f"config={conf}", "num_trees=7"])
+    assert params["num_trees"] == "7"  # argv wins (application.cpp:46-104)
+    assert params["learning_rate"] == "0.1"
+    assert "config" not in params
+
+
+def test_binary_train_and_predict_conf(in_example_dir, capsys):
+    tmp = in_example_dir("binary_classification")
+    model = str(tmp / "model.txt")
+    result = str(tmp / "pred.txt")
+    rc = main(["config=train.conf", "num_trees=5", f"output_model={model}",
+               "is_save_binary_file=false"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "finished iteration 5" in out
+    assert "binary.test" in out and "auc" in out  # valid metrics printed
+    assert os.path.exists(model)
+    with open(model) as fh:
+        assert fh.readline().strip() == "gbdt"
+
+    rc = main(["config=predict.conf", f"input_model={model}",
+               f"output_result={result}"])
+    assert rc == 0
+    preds = np.loadtxt(result)
+    assert preds.shape == (500,)
+    assert np.all((preds >= 0) & (preds <= 1))  # sigmoid applied
+    # predictions separate classes on the test file
+    labels = np.loadtxt("binary.test")[:, 0]
+    auc_ordering = np.mean(preds[labels == 1]) > np.mean(preds[labels == 0])
+    assert auc_ordering
+
+
+def test_regression_conf(in_example_dir):
+    tmp = in_example_dir("regression")
+    model = str(tmp / "model.txt")
+    rc = main(["config=train.conf", "num_trees=5", f"output_model={model}",
+               "is_save_binary_file=false"])
+    assert rc == 0
+    assert os.path.exists(model)
+
+
+def test_lambdarank_conf(in_example_dir, capsys):
+    tmp = in_example_dir("lambdarank")
+    model = str(tmp / "model.txt")
+    result = str(tmp / "pred.txt")
+    rc = main(["config=train.conf", "num_trees=5", f"output_model={model}"])
+    assert rc == 0
+    assert "ndcg" in capsys.readouterr().out
+    rc = main(["config=predict.conf", f"input_model={model}",
+               f"output_result={result}"])
+    assert rc == 0
+    assert os.path.exists(result)
+
+
+def test_multiclass_conf(in_example_dir):
+    tmp = in_example_dir("multiclass_classification")
+    model = str(tmp / "model.txt")
+    result = str(tmp / "pred.txt")
+    rc = main(["config=train.conf", "num_trees=3", f"output_model={model}"])
+    assert rc == 0
+    rc = main(["config=predict.conf", f"input_model={model}",
+               f"output_result={result}"])
+    assert rc == 0
+    preds = np.loadtxt(result)
+    assert preds.ndim == 2 and preds.shape[1] == 5  # per-class probabilities
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_early_stopping_cli(in_example_dir, capsys):
+    tmp = in_example_dir("binary_classification")
+    model = str(tmp / "model.txt")
+    rc = main(["config=train.conf", "num_trees=60", "learning_rate=0.9",
+               "early_stopping_round=2", "num_leaves=63",
+               f"output_model={model}"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # with lr=0.9 the valid metric degrades quickly -> early stop fires
+    assert "Early stopping at iteration" in out
+
+
+def test_predict_leaf_index(in_example_dir):
+    tmp = in_example_dir("binary_classification")
+    model = str(tmp / "model.txt")
+    result = str(tmp / "leaves.txt")
+    main(["config=train.conf", "num_trees=3", f"output_model={model}"])
+    rc = main(["task=predict", "data=binary.test", f"input_model={model}",
+               f"output_result={result}", "is_predict_leaf_index=true"])
+    assert rc == 0
+    leaves = np.loadtxt(result)
+    assert leaves.shape == (500, 3)
+    assert np.all(leaves == leaves.astype(int))
+
+
+def test_bad_config_fails(tmp_path):
+    rc = main(["task=train", "data=/definitely/missing.csv",
+               f"output_model={tmp_path}/m.txt"])
+    assert rc == 1

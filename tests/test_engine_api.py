@@ -216,3 +216,29 @@ def test_dataset_fields_and_binary(tmp_path):
     back = BinnedDataset.load_binary(path)
     assert back.num_data == 400
     np.testing.assert_array_equal(back.X_bin, ds.construct().X_bin)
+
+
+def test_continued_training_with_valid_set(tmp_path):
+    """Loaded init_model trees must replay correctly onto valid-set scores
+    (they carry only raw thresholds; bin fields must be re-bound)."""
+    X, y = make_binary(900)
+    Xtr, ytr, Xv, yv = X[:600], y[:600], X[600:], y[600:]
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst1 = lgb.train(PARAMS, train, num_boost_round=8, verbose_eval=False)
+    path = os.path.join(tmp_path, "m.txt")
+    bst1.save_model(path)
+
+    train2 = lgb.Dataset(Xtr, label=ytr)
+    valid2 = train2.create_valid(Xv, label=yv)
+    evals = {}
+    lgb.train(PARAMS, train2, num_boost_round=4, init_model=path,
+              valid_sets=[valid2], valid_names=["v"], evals_result=evals,
+              verbose_eval=False)
+    # valid logloss at the first continued iteration must match a direct
+    # evaluation of the merged model — i.e. the replayed valid scores are real
+    direct = lgb.Booster(model_file=path).predict(Xv)
+    def logloss(p):
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return -np.mean(yv * np.log(p) + (1 - yv) * np.log(1 - p))
+    assert evals["v"]["binary_logloss"][0] < logloss(direct) + 0.05
+    assert evals["v"]["binary_logloss"][-1] <= evals["v"]["binary_logloss"][0]

@@ -191,3 +191,22 @@ def test_feature_importances():
     # goes to the smallest feature index); gain importance is unambiguous
     gain = clf.booster_.feature_importance(importance_type="gain")
     assert np.argmax(gain) == 2
+
+
+def test_classifier_eval_set_string_labels():
+    """eval_set labels go through the same encoding as y (review fix)."""
+    rng = np.random.RandomState(41)
+    X = rng.randn(600, 5)
+    y = np.where(X[:, 0] > 0, "yes", "no")
+    clf = lgb.LGBMClassifier(**{**COMMON, "n_estimators": 10})
+    clf.fit(X[:400], y[:400], eval_set=[(X[400:], y[400:])],
+            eval_metric=["binary_logloss"])
+    assert clf.evals_result_["valid_0"]["binary_logloss"][-1] < 0.6
+    # refitting on a different class count must not be poisoned by the
+    # previous fit (objective stays as constructed)
+    y3 = rng.randint(0, 3, 600)
+    clf.fit(X, y3)
+    assert clf.n_classes_ == 3
+    clf.fit(X[:400], (X[:400, 0] > 0).astype(int))
+    assert clf.n_classes_ == 2
+    assert clf.get_params()["objective"] == "binary"
