@@ -52,8 +52,6 @@ class Predictor:
         self.is_leaf = is_predict_leaf_index
 
     def predict_file(self, data_path: str, result_path: str, has_header: bool = False) -> None:
-        from .basic import Booster
-
         out = self.booster.predict(
             data_path,
             raw_score=self.is_raw_score,
@@ -163,7 +161,11 @@ def run_train(cfg: Config) -> GBDT:
                      "that meet the split requirements.")
             break
 
-    num_iteration = best_model_iter if stop_early else -1
+    # slice counts iterations from the model start, so prepended
+    # init-model trees are part of the budget (gbdt.cpp:589-592)
+    num_iteration = (
+        booster.num_init_iteration + best_model_iter if stop_early else -1
+    )
     booster.save_model_to_file(cfg.output_model, num_iteration)
     Log.info(f"Finished training, saved model to {cfg.output_model}")
     return booster

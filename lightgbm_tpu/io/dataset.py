@@ -31,6 +31,58 @@ from .parser import parse_file
 BINARY_MAGIC = "lightgbm_tpu_binned_dataset_v1"
 
 
+def _encode_bins(
+    X: np.ndarray,
+    used_map: np.ndarray,
+    mappers: List[BinMapper],
+    X_bin: np.ndarray,
+) -> None:
+    """Fill ``X_bin[:, inner] = mappers[inner].value_to_bin(X[:, orig])``
+    for every used column — the Feature::PushData loop
+    (dataset_loader.cpp:761, feature.h:79-85).  Numerical features go
+    through the native OpenMP batch encoder when available."""
+    from .. import native
+
+    num_orig: List[int] = []
+    num_inner: List[int] = []
+    num_bounds: List[np.ndarray] = []
+    rest: List[Tuple[int, int]] = []
+    for orig, inner in enumerate(used_map):
+        if inner < 0:
+            continue
+        m = mappers[inner]
+        if m.bin_type == NUMERICAL:
+            num_orig.append(orig)
+            num_inner.append(int(inner))
+            num_bounds.append(np.asarray(m.bin_upper_bound, np.float64))
+        else:
+            rest.append((orig, int(inner)))
+
+    if num_orig:
+        inner_arr = np.asarray(num_inner)
+        direct = (
+            X_bin.flags.c_contiguous
+            and len(num_orig) == X_bin.shape[1]
+            and np.array_equal(inner_arr, np.arange(X_bin.shape[1]))
+        )
+        out = X_bin if direct else np.empty(
+            (X.shape[0], len(num_orig)), X_bin.dtype
+        )
+        if native.value_to_bin_numerical(
+            np.ascontiguousarray(X, np.float64),
+            np.asarray(num_orig, np.int64),
+            num_bounds,
+            out,
+        ):
+            if not direct:
+                X_bin[:, inner_arr] = out
+        else:  # pure-python fallback
+            rest = list(zip(num_orig, num_inner)) + rest
+
+    for orig, inner in rest:
+        X_bin[:, inner] = mappers[inner].value_to_bin(X[:, orig])
+
+
 def _resolve_column(spec: str, names: Optional[List[str]]) -> Optional[int]:
     """Resolve 'name:foo' or integer-string column spec to an index
     (dataset_loader.cpp:23-160)."""
@@ -158,9 +210,7 @@ class BinnedDataset:
 
         dtype = np.uint8 if max((m.num_bin for m in used_mappers), default=1) <= 256 else np.uint16
         X_bin = np.empty((n, len(used_mappers)), dtype=dtype)
-        for orig, inner in enumerate(used_map):
-            if inner >= 0:
-                X_bin[:, inner] = used_mappers[inner].value_to_bin(X[:, orig])
+        _encode_bins(X, used_map, used_mappers, X_bin)
         return BinnedDataset(
             X_bin, used_mappers, used_map, f_total, metadata, feature_names
         )
@@ -176,9 +226,7 @@ class BinnedDataset:
             pad = np.zeros((n, self.num_total_features - f_total), dtype=np.float64)
             X = np.hstack([X, pad])
         X_bin = np.empty((n, self.num_features), dtype=self.X_bin.dtype)
-        for orig, inner in enumerate(self.used_feature_map):
-            if inner >= 0:
-                X_bin[:, inner] = self.bin_mappers[inner].value_to_bin(X[:, orig])
+        _encode_bins(X, self.used_feature_map, self.bin_mappers, X_bin)
         return BinnedDataset(
             X_bin,
             self.bin_mappers,

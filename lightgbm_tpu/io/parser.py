@@ -57,6 +57,18 @@ def parse_file(
     head = _read_head(path, 2 if not has_header else 3)
     if fmt is None:
         fmt = detect_format(head[1:] if has_header else head)
+
+    # native fast path (src/native/lgbm_native.cpp; OpenMP row-parallel)
+    from .. import native
+
+    mat = native.parse_file(path, fmt, skip_header=has_header)
+    if mat is not None:
+        names = None
+        if has_header and head:
+            sep = "," if fmt == "csv" else None
+            names = [s.strip() for s in head[0].strip().split(sep)]
+        return mat, names
+
     if fmt == "libsvm":
         with open(path, "r") as fh:
             if has_header:

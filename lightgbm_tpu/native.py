@@ -1,0 +1,177 @@
+"""ctypes bindings for the native data-loading runtime.
+
+The reference reaches its C++ core through ctypes (python-package/
+lightgbm/basic.py:30-40 loading lib_lightgbm.so); we do the same for the
+host-side ingest library (src/native/lgbm_native.cpp) that accelerates
+text parsing and the value->bin encode.  The library is built on demand
+with g++ (cached next to the package); every entry point has a pure
+Python fallback, so the framework works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .log import Log
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lib")
+_LIB_PATH = os.path.join(_LIB_DIR, "liblgbm_native.so")
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "src", "native", "lgbm_native.cpp",
+)
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-Wall", "-fPIC", "-fopenmp",
+           "-shared", "-o", _LIB_PATH, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        Log.warning(f"native build failed, using python IO: {proc.stderr[:500]}")
+        return False
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            Log.warning(f"native lib load failed, using python IO: {e}")
+            return None
+        lib.lgbm_parse_delimited.restype = ctypes.c_int
+        lib.lgbm_parse_delimited.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.lgbm_parse_libsvm.restype = ctypes.c_int
+        lib.lgbm_parse_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.lgbm_detect_format.restype = ctypes.c_int
+        lib.lgbm_detect_format.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.lgbm_value_to_bin.restype = None
+        lib.lgbm_value_to_bin.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_long),
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.lgbm_free.restype = None
+        lib.lgbm_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def detect_format(path: str, skip_header: bool) -> Optional[str]:
+    lib = _load()
+    if lib is None:
+        return None
+    code = lib.lgbm_detect_format(path.encode(), int(skip_header))
+    return {1: "csv", 2: "tsv", 3: "libsvm"}.get(code)
+
+
+def parse_file(path: str, fmt: str, skip_header: bool) -> Optional[np.ndarray]:
+    """Parse with the native runtime; None -> caller falls back to Python."""
+    lib = _load()
+    if lib is None:
+        return None
+    data_p = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    if fmt == "libsvm":
+        rc = lib.lgbm_parse_libsvm(
+            path.encode(), int(skip_header),
+            ctypes.byref(data_p), ctypes.byref(rows), ctypes.byref(cols),
+        )
+    else:
+        rc = lib.lgbm_parse_delimited(
+            path.encode(), 1 if fmt == "csv" else 2, int(skip_header),
+            ctypes.byref(data_p), ctypes.byref(rows), ctypes.byref(cols),
+        )
+    if rc != 0:
+        return None
+    n, f = rows.value, cols.value
+    try:
+        out = np.ctypeslib.as_array(data_p, shape=(n, f)).copy()
+    finally:
+        lib.lgbm_free(data_p)
+    return out
+
+
+def value_to_bin_numerical(
+    X: np.ndarray,
+    col_idx: np.ndarray,
+    bounds_list: List[np.ndarray],
+    out: np.ndarray,
+) -> bool:
+    """Batch value->bin encode for numerical features into ``out``
+    (row-major [n, n_used] u8/u16 slice-compatible array).  Returns False
+    when the native path is unavailable (caller uses numpy)."""
+    lib = _load()
+    if lib is None:
+        return False
+    if out.dtype == np.uint8:
+        is_u16 = 0
+    elif out.dtype == np.uint16:
+        is_u16 = 1
+    else:
+        return False
+    if not (X.flags.c_contiguous and out.flags.c_contiguous):
+        return False
+    X = np.ascontiguousarray(X, np.float64)
+    col_idx = np.ascontiguousarray(col_idx, np.int64)
+    offsets = np.zeros(len(bounds_list) + 1, np.int64)
+    for i, b in enumerate(bounds_list):
+        offsets[i + 1] = offsets[i] + len(b)
+    bounds = (
+        np.concatenate(bounds_list).astype(np.float64)
+        if bounds_list
+        else np.zeros(0, np.float64)
+    )
+    bounds = np.ascontiguousarray(bounds)
+    lib.lgbm_value_to_bin(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        X.shape[0], X.shape[1],
+        col_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(col_idx),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        out.ctypes.data_as(ctypes.c_void_p),
+        is_u16,
+    )
+    return True

@@ -304,7 +304,13 @@ class LGBMClassifier(_SKLClassifierMixin, LGBMModel):
                 eval_set = [eval_set]
             encoded = []
             for vx, vy in eval_set:
-                vy_enc = np.searchsorted(self.classes_, np.asarray(vy))
+                vy = np.asarray(vy)
+                vy_enc = np.searchsorted(self.classes_, vy)
+                in_range = vy_enc < len(self.classes_)
+                if not (np.all(in_range) and np.all(self.classes_[np.where(in_range, vy_enc, 0)] == vy)):
+                    raise LightGBMError(
+                        "eval_set contains labels unseen in training data"
+                    )
                 encoded.append((vx, vy_enc.astype(np.float64)))
             kwargs["eval_set"] = encoded
         super().fit(X, y_enc.astype(np.float64), _extra_params=extra, **kwargs)
