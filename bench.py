@@ -120,6 +120,14 @@ def reference_sec_per_tree(X, y, key: str) -> float | None:
 def ours_sec_per_tree(X, y) -> tuple[float, float]:
     import jax
 
+    # Local sanity runs: BENCH_PLATFORM=cpu pins the CPU backend via
+    # jax.config (the env var alone doesn't stop the axon plugin's
+    # device-init from dialing the TPU tunnel).  The driver's real bench
+    # run leaves this unset and lands on the TPU chip.
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.io.metadata import Metadata
@@ -131,6 +139,9 @@ def ours_sec_per_tree(X, y) -> tuple[float, float]:
         objective="binary", num_leaves=NUM_LEAVES, max_bin=NUM_BINS,
         learning_rate=LEARNING_RATE, min_data_in_leaf=MIN_DATA,
         metric=["auc"],
+        # level-synchronous growth: one fused histogram pass per level
+        # instead of per split — the TPU-fast mode (learners/depthwise.py)
+        tree_growth=os.environ.get("BENCH_GROWTH", "depthwise"),
     )
     t0 = time.perf_counter()
     ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)

@@ -1,3 +1,4 @@
 from .serial import grow_tree, TreeLearnerParams
+from .depthwise import grow_tree_depthwise
 
-__all__ = ["grow_tree", "TreeLearnerParams"]
+__all__ = ["grow_tree", "grow_tree_depthwise", "TreeLearnerParams"]

@@ -1,0 +1,225 @@
+"""Depthwise (level-synchronous) tree learner — the fast TPU growth mode.
+
+The reference grows strictly best-first, one leaf at a time
+(serial_tree_learner.cpp:116-150), which on TPU costs one full histogram
+pass over the data PER SPLIT.  This learner grows a whole level per
+iteration: ONE fused histogram pass builds ``hist[L, F, B, 3]`` for every
+live leaf simultaneously (ops/histogram.histogram_by_leaf — the segment
+keys fuse leaf x bin), one vmapped split search scores every leaf, and one
+vectorized partition pass routes every row.  A tree of depth D costs D
+passes instead of num_leaves-1 — ~30x fewer at 255 leaves.
+
+LightGBM's ``num_leaves`` budget (its defining hyperparameter,
+docs/Parameters-tuning.md:9) is preserved: when a level proposes more
+splits than the remaining budget, only the highest-gain splits are taken
+(gain-descending, leaf-index tie-break), which is exactly the order the
+best-first learner would have chosen among that frontier.  Trees are
+therefore not always node-identical to leaf-wise trees (a best-first
+learner may descend one subtree before finishing the level), but every
+split still clears the same gain/min_data/min_hessian constraints and
+accuracy tracks the leaf-wise learner closely; leafwise stays the
+default/compat mode (config.tree_growth).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.tree import Tree, empty_tree
+from ..ops.histogram import histogram_by_leaf
+from ..ops.split import SplitResult, find_best_split_leaves, K_MIN_SCORE
+from .serial import TreeLearnerParams
+
+
+class _LevelState(NamedTuple):
+    leaf_id: jax.Array  # [n] row -> leaf
+    tree: Tree
+    num_leaves: jax.Array  # scalar int32
+    depth: jax.Array  # scalar int32, current level
+    keep_going: jax.Array  # scalar bool
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "max_leaves", "hist_fn", "reduce_fn")
+)
+def grow_tree_depthwise(
+    bins_T: jax.Array,  # [F, n]
+    grad: jax.Array,
+    hess: jax.Array,
+    bag_mask: jax.Array,
+    feature_mask: jax.Array,
+    num_bins_per_feature: jax.Array,
+    is_categorical: jax.Array,
+    params: TreeLearnerParams,
+    num_bins: int,
+    max_leaves: int,
+    hist_fn=None,
+    reduce_fn=None,
+) -> Tuple[Tree, jax.Array]:
+    """Grow one tree level-by-level; returns (tree, final leaf_id).
+
+    ``hist_fn(bins_T, leaf_id, grad, hess, mask, num_leaves) -> [L, F, B, 3]``
+    abstracts the fused histogram so the data-parallel learner can psum the
+    level histogram across the mesh; ``reduce_fn`` is unused here (root
+    stats come from the reduced histogram) but accepted for signature
+    parity with the leaf-wise grower.
+    """
+    F, n = bins_T.shape
+    L = max_leaves
+
+    if hist_fn is None:
+        def hist_fn(bt, lid, g, h, m, num_leaves):
+            return histogram_by_leaf(
+                bt, lid, g, h, m, num_bins=num_bins, num_leaves=num_leaves
+            )
+
+    max_levels = jnp.where(
+        params.max_depth > 0, params.max_depth, jnp.int32(L - 1)
+    )
+
+    def level_body(state: _LevelState) -> _LevelState:
+        t = state.tree
+        # ---- one fused histogram pass for every live leaf
+        hist = hist_fn(bins_T, state.leaf_id, grad, hess, bag_mask, L)
+        # per-leaf totals from any feature's bins (all features see every
+        # row, so feature 0's bin sums are the leaf sums)
+        leaf_tot = jnp.sum(hist[:, 0, :, :], axis=1)  # [L, 3]
+        sum_g, sum_h, cnt = leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2]
+
+        live = jnp.arange(L, dtype=jnp.int32) < state.num_leaves
+        depth_ok = (params.max_depth <= 0) | (t.leaf_depth < params.max_depth)
+        can_split = live & depth_ok
+
+        best: SplitResult = find_best_split_leaves(
+            hist, sum_g, sum_h, cnt,
+            feature_mask, num_bins_per_feature, is_categorical,
+            params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
+            params.lambda_l1, params.lambda_l2, params.min_gain_to_split,
+            can_split,
+        )
+
+        # ---- budget selection: top-gain splits, at most L - num_leaves
+        gains = jnp.where(best.gain > 0.0, best.gain, K_MIN_SCORE)
+        order = jnp.argsort(-gains)  # stable: leaf-index tie-break
+        rank = jnp.zeros(L, jnp.int32).at[order].set(
+            jnp.arange(L, dtype=jnp.int32)
+        )
+        budget = L - state.num_leaves
+        selected = (gains > K_MIN_SCORE) & (rank < budget)
+        n_sel = jnp.sum(selected.astype(jnp.int32))
+
+        # ---- sequential node numbering in gain order (matches the order
+        # best-first would take these splits): i-th selected split gets
+        # node = num_leaves-1+i, its right child leaf = num_leaves+i
+        sel_in_order = selected[order]  # [L] bool, order[i] = leaf
+        slot = jnp.cumsum(sel_in_order.astype(jnp.int32)) - 1  # per order pos
+        slot_of_leaf = jnp.zeros(L, jnp.int32).at[order].set(slot)
+        node_of_leaf = jnp.where(
+            selected, state.num_leaves - 1 + slot_of_leaf, -1
+        )
+        new_leaf_of = jnp.where(selected, state.num_leaves + slot_of_leaf, -1)
+
+        # ---- tree bookkeeping, fully vectorized over selected leaves.
+        # Unselected lanes are routed to an out-of-range index: JAX's
+        # default scatter mode DROPS out-of-bounds updates, giving a clean
+        # masked scatter with no read-modify-write races on shared slots.
+        leaves = jnp.arange(L, dtype=jnp.int32)
+        node_idx = jnp.where(selected, node_of_leaf, L - 1)  # L-1 OOB: len L-1
+
+        def scatter(arr, values):
+            return arr.at[node_idx].set(values)
+
+        split_feature = scatter(t.split_feature, best.feature)
+        threshold_bin = scatter(t.threshold_bin, best.threshold)
+        decision_type = scatter(
+            t.decision_type, is_categorical[best.feature].astype(jnp.int32)
+        )
+        split_gain = scatter(t.split_gain, best.gain)
+        internal_value = scatter(t.internal_value, t.leaf_value[leaves])
+        internal_count = scatter(
+            t.internal_count, best.left_count + best.right_count
+        )
+        left_child = scatter(t.left_child, ~leaves)
+        right_child = scatter(t.right_child, ~new_leaf_of)
+
+        # parent hookup: the split leaf's old parent node now points at the
+        # new internal node (Tree::Split, tree.cpp:78-89).  Two sibling
+        # leaves splitting in the same level target the same parent node on
+        # different sides, so each side is its own drop-mode scatter.
+        parent = t.leaf_parent[leaves]  # [L]
+        has_parent = selected & (parent >= 0)
+        pidx = jnp.maximum(parent, 0)
+        was_left = t.left_child[pidx] == ~leaves
+        left_child = left_child.at[
+            jnp.where(has_parent & was_left, pidx, L - 1)
+        ].set(node_of_leaf)
+        right_child = right_child.at[
+            jnp.where(has_parent & ~was_left, pidx, L - 1)
+        ].set(node_of_leaf)
+
+        depth_child = t.leaf_depth[leaves] + 1
+        leaf_sel = selected
+
+        def set_leaf(arr, left_vals, right_vals):
+            # leaf arrays have length L, so L itself is the drop index
+            arr = arr.at[jnp.where(leaf_sel, leaves, L)].set(left_vals)
+            return arr.at[jnp.where(leaf_sel, new_leaf_of, L)].set(right_vals)
+
+        leaf_value = set_leaf(t.leaf_value, best.left_output, best.right_output)
+        leaf_count = set_leaf(t.leaf_count, best.left_count, best.right_count)
+        leaf_parent = set_leaf(t.leaf_parent, node_of_leaf, node_of_leaf)
+        leaf_depth = set_leaf(t.leaf_depth, depth_child, depth_child)
+
+        tree = t._replace(
+            num_leaves=state.num_leaves + n_sel,
+            split_feature=split_feature,
+            threshold_bin=threshold_bin,
+            decision_type=decision_type,
+            left_child=left_child,
+            right_child=right_child,
+            split_gain=split_gain,
+            internal_value=internal_value,
+            internal_count=internal_count,
+            leaf_value=leaf_value,
+            leaf_count=leaf_count,
+            leaf_parent=leaf_parent,
+            leaf_depth=leaf_depth,
+        )
+
+        # ---- one partition pass for the whole level
+        lid = state.leaf_id
+        f_row = best.feature[lid]  # [n]
+        v_row = bins_T[jnp.maximum(f_row, 0), jnp.arange(n)].astype(jnp.int32)
+        thr_row = best.threshold[lid]
+        cat_row = is_categorical[jnp.maximum(f_row, 0)]
+        go_left = jnp.where(cat_row, v_row == thr_row, v_row <= thr_row)
+        sel_row = selected[lid]
+        leaf_id = jnp.where(sel_row & ~go_left, new_leaf_of[lid], lid)
+
+        keep_going = (
+            (n_sel > 0)
+            & (state.num_leaves + n_sel < L)
+            & (state.depth + 1 < max_levels)
+        )
+        return _LevelState(
+            leaf_id=leaf_id,
+            tree=tree,
+            num_leaves=state.num_leaves + n_sel,
+            depth=state.depth + 1,
+            keep_going=keep_going,
+        )
+
+    init = _LevelState(
+        leaf_id=jnp.zeros(n, jnp.int32),
+        tree=empty_tree(L),
+        num_leaves=jnp.int32(1),
+        depth=jnp.int32(0),
+        keep_going=jnp.bool_(True),
+    )
+    final = jax.lax.while_loop(lambda s: s.keep_going, level_body, init)
+    tree = final.tree._replace(num_leaves=final.num_leaves)
+    return tree, final.leaf_id

@@ -118,6 +118,14 @@ class GBDT:
         world (network.cpp:20-38) is the mesh's row axis."""
         tl = self.config.tree_learner
         if tl == "serial" or len(jax.devices()) == 1:
+            if self.config.tree_growth == "depthwise":
+                from ..learners.depthwise import grow_tree_depthwise
+
+                return functools.partial(
+                    grow_tree_depthwise,
+                    num_bins=self._num_bins,
+                    max_leaves=self.max_leaves,
+                )
             return functools.partial(
                 grow_tree, num_bins=self._num_bins, max_leaves=self.max_leaves
             )
@@ -144,7 +152,10 @@ class GBDT:
                 top_k=self.config.top_k,
             )
         return make_data_parallel_grower(
-            mesh, num_bins=self._num_bins, max_leaves=self.max_leaves
+            mesh,
+            num_bins=self._num_bins,
+            max_leaves=self.max_leaves,
+            growth=self.config.tree_growth,
         )
 
     def add_valid_dataset(self, valid_set: BinnedDataset, name: str) -> None:

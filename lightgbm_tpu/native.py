@@ -35,13 +35,18 @@ def _build() -> bool:
     if not os.path.exists(_SRC):
         return False
     os.makedirs(_LIB_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-std=c++17", "-Wall", "-fPIC", "-fopenmp",
-           "-shared", "-o", _LIB_PATH, _SRC]
+    # the Makefile is the single source of truth for compile flags
+    makefile_dir = os.path.dirname(_SRC)
+    if os.path.exists(os.path.join(makefile_dir, "Makefile")):
+        cmd = ["make", "-C", makefile_dir, "--always-make"]
+    else:
+        cmd = ["g++", "-O3", "-std=c++17", "-Wall", "-fPIC", "-fopenmp",
+               "-shared", "-o", _LIB_PATH, _SRC]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
         return False
-    if proc.returncode != 0:
+    if proc.returncode != 0 or not os.path.exists(_LIB_PATH):
         Log.warning(f"native build failed, using python IO: {proc.stderr[:500]}")
         return False
     return True

@@ -33,25 +33,47 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..learners.depthwise import grow_tree_depthwise
 from ..learners.serial import grow_tree
-from ..ops.histogram import histogram_feature_major
+from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from .mesh import ROW_AXIS, row_padded_grower
 
 
-def make_data_parallel_grower(mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS):
+def make_data_parallel_grower(
+    mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
+    growth: str = "leafwise",
+):
     """Build a grow(bins_T, grad, hess, bag_mask, feature_mask,
     num_bins_per_feature, is_categorical, params) -> (tree, leaf_id)
-    callable running the serial growth algorithm SPMD over ``mesh``."""
+    callable running the serial growth algorithm SPMD over ``mesh``.
+
+    ``growth="depthwise"`` runs the level-synchronous learner instead:
+    the per-level fused histogram is psum'd once per LEVEL (one collective
+    per level instead of one per split — even less comm than the
+    reference's per-level reduce-scatter)."""
     num_shards = mesh.shape[axis]
     hist_local = functools.partial(histogram_feature_major, num_bins=num_bins)
 
     def hist_psum(bins_T, grad, hess, mask):
         return jax.lax.psum(hist_local(bins_T, grad, hess, mask), axis)
 
+    def level_hist_psum(bins_T, leaf_id, grad, hess, mask, num_leaves):
+        local = histogram_by_leaf(
+            bins_T, leaf_id, grad, hess, mask,
+            num_bins=num_bins, num_leaves=num_leaves,
+        )
+        return jax.lax.psum(local, axis)
+
     def reduce_sum(x):
         return jax.lax.psum(x, axis)
 
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
+        if growth == "depthwise":
+            return grow_tree_depthwise(
+                bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
+                num_bins=num_bins, max_leaves=max_leaves,
+                hist_fn=level_hist_psum,
+            )
         return grow_tree(
             bins_T,
             grad,

@@ -37,6 +37,10 @@ bool ReadFile(const char* path, std::vector<char>* out) {
   if (fp == nullptr) return false;
   std::fseek(fp, 0, SEEK_END);
   long size = std::ftell(fp);
+  if (size < 0) {  // non-seekable (FIFO etc.): let the Python path read it
+    std::fclose(fp);
+    return false;
+  }
   std::fseek(fp, 0, SEEK_SET);
   out->resize(static_cast<size_t>(size) + 1);
   size_t got = std::fread(out->data(), 1, static_cast<size_t>(size), fp);
@@ -64,18 +68,34 @@ inline bool IsSep(char c, char sep) {
   return sep == ' ' ? (c == ' ' || c == '\t') : c == sep;
 }
 
-// Parse one delimited line into row[0..cols); missing/empty -> NaN.
-// Returns number of fields parsed.
-long ParseDelimited(const char* s, const char* end, char sep, double* row,
+// Recognized NA spellings (the python path's pandas na_values set:
+// io/parser.py — "", "NA", "nan", "NaN").
+bool IsNaToken(const char* p, const char* end) {
+  size_t len = static_cast<size_t>(end - p);
+  if (len == 0) return true;
+  if (len == 2 && p[0] == 'N' && p[1] == 'A') return true;
+  if (len == 3 && (std::strncmp(p, "nan", 3) == 0 ||
+                   std::strncmp(p, "NaN", 3) == 0 ||
+                   std::strncmp(p, "NAN", 3) == 0))
+    return true;
+  return false;
+}
+
+// Parse one delimited line into row[0..cols); missing/empty/NA -> NaN.
+// Returns false on malformed input (extra fields or non-numeric garbage)
+// so the caller can fail the whole parse and fall back to the strict
+// Python reader — silent truncation must never feed training.
+bool ParseDelimited(const char* s, const char* end, char sep, double* row,
                     long cols) {
   long j = 0;
   const char* p = s;
-  while (p < end && j < cols) {
+  while (p < end) {
     // skip leading blanks inside field boundaries for space-separated
     if (sep == ' ') {
       while (p < end && (*p == ' ' || *p == '\t')) ++p;
       if (p >= end) break;
     }
+    if (j >= cols) return false;  // ragged line with EXTRA fields
     const char* field_end = p;
     while (field_end < end && !IsSep(*field_end, sep)) ++field_end;
     if (field_end == p) {
@@ -83,13 +103,19 @@ long ParseDelimited(const char* s, const char* end, char sep, double* row,
     } else {
       char* q = nullptr;
       double v = std::strtod(p, &q);
-      row[j++] = (q == p) ? NAN : v;
+      if (q == field_end) {
+        row[j++] = v;
+      } else if (IsNaToken(p, field_end)) {
+        row[j++] = NAN;
+      } else {
+        return false;  // malformed numeric (e.g. "1.5abc")
+      }
     }
     p = field_end;
     if (sep != ' ' && p < end && IsSep(*p, sep)) ++p;
   }
-  while (j < cols) row[j++] = NAN;
-  return j;
+  while (j < cols) row[j++] = NAN;  // SHORT lines pad with NaN (pandas-like)
+  return true;
 }
 
 // Count fields of a delimited line.
@@ -169,8 +195,9 @@ int lgbm_parse_delimited(const char* path, int fmt, int skip_header,
   if (lines.size() <= first) return 2;
   long n = static_cast<long>(lines.size() - first);
 
-  char sep = fmt == 1 ? ',' : ' ';
-  {  // honor real tabs for fmt 2
+  char sep = ',';
+  if (fmt != 1) {  // fmt 2: whitespace, honoring real tabs
+    sep = ' ';
     const char* s = buf.data() + lines[first].first;
     const char* e = buf.data() + lines[first].second;
     for (const char* p = s; p < e; ++p)
@@ -187,11 +214,17 @@ int lgbm_parse_delimited(const char* path, int fmt, int skip_header,
       static_cast<double*>(std::malloc(sizeof(double) * n * cols));
   if (data == nullptr) return 4;
 
-#pragma omp parallel for schedule(static)
+  int bad = 0;
+#pragma omp parallel for schedule(static) reduction(| : bad)
   for (long i = 0; i < n; ++i) {
     const auto& ln = lines[first + i];
-    ParseDelimited(buf.data() + ln.first, buf.data() + ln.second, sep,
-                   data + i * cols, cols);
+    if (!ParseDelimited(buf.data() + ln.first, buf.data() + ln.second, sep,
+                        data + i * cols, cols))
+      bad |= 1;
+  }
+  if (bad) {  // malformed file: strict python reader takes over
+    std::free(data);
+    return 5;
   }
   *out_data = data;
   *out_rows = n;
@@ -211,12 +244,17 @@ int lgbm_parse_libsvm(const char* path, int skip_header, double** out_data,
   if (lines.size() <= first) return 2;
   long n = static_cast<long>(lines.size() - first);
 
-  // pass 1: max feature index (parallel reduction)
+  // pass 1: max feature index (parallel reduction).  Non-integer index
+  // tokens (e.g. "qid:3") make the whole parse fail so the strict python
+  // path reports them instead of silently corrupting column 0.
   long max_idx = -1;
-#pragma omp parallel for schedule(static) reduction(max : max_idx)
+  int bad = 0;
+#pragma omp parallel for schedule(static) reduction(max : max_idx) \
+    reduction(| : bad)
   for (long i = 0; i < n; ++i) {
     const char* p = buf.data() + lines[first + i].first;
     const char* end = buf.data() + lines[first + i].second;
+    bool first_tok = true;
     while (p < end) {
       const char* colon = nullptr;
       const char* tok = p;
@@ -224,13 +262,24 @@ int lgbm_parse_libsvm(const char* path, int skip_header, double** out_data,
         if (*p == ':') colon = p;
         ++p;
       }
-      if (colon != nullptr && colon > tok) {
-        long idx = std::strtol(tok, nullptr, 10);
-        if (idx > max_idx) max_idx = idx;
+      if (!first_tok) {
+        if (colon == nullptr || colon == tok) {
+          bad |= 1;
+        } else {
+          char* q = nullptr;
+          long idx = std::strtol(tok, &q, 10);
+          if (q != colon) {
+            bad |= 1;  // index token isn't a pure integer ("qid" et al)
+          } else if (idx > max_idx) {
+            max_idx = idx;
+          }
+        }
       }
+      first_tok = false;
       while (p < end && (*p == ' ' || *p == '\t')) ++p;
     }
   }
+  if (bad) return 5;
   long cols = max_idx + 2;  // +1 label column
   double* data =
       static_cast<double*>(std::calloc(static_cast<size_t>(n) * cols,

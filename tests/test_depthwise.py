@@ -1,0 +1,145 @@
+"""Depthwise (level-synchronous) grower tests: structural invariants,
+budget/max_depth enforcement, consistency with the tree's own decision
+program, and accuracy parity with the leaf-wise learner."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.learners.depthwise import grow_tree_depthwise
+from lightgbm_tpu.learners.serial import TreeLearnerParams, grow_tree
+from lightgbm_tpu.models.tree import predict_leaf_binned
+
+
+def _setup(n=4000, f=8, n_bins=32, seed=0):
+    rng = np.random.RandomState(seed)
+    X_bin = rng.randint(0, n_bins, size=(n, f)).astype(np.uint8)
+    z = (X_bin[:, 0].astype(float) - n_bins / 2) + 0.5 * (
+        X_bin[:, 1].astype(float) - n_bins / 2
+    )
+    y = (z + rng.randn(n) * 3 > 0).astype(np.float32)
+    score = np.zeros(n, np.float32)
+    p = 1 / (1 + np.exp(-2 * score))
+    grad = (p - y).astype(np.float32)
+    hess = (2 * p * (1 - p)).astype(np.float32)
+    return X_bin, grad, hess, n_bins
+
+
+def _grow(growth, X_bin, grad, hess, n_bins, max_leaves, **cfg_kw):
+    cfg = Config(min_data_in_leaf=cfg_kw.pop("min_data_in_leaf", 20),
+                 min_sum_hessian_in_leaf=1.0, num_leaves=max_leaves, **cfg_kw)
+    params = TreeLearnerParams.from_config(cfg)
+    f = X_bin.shape[1]
+    args = (
+        jnp.asarray(X_bin.T), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(len(grad), jnp.float32), jnp.ones(f, bool),
+        jnp.full(f, n_bins, jnp.int32), jnp.zeros(f, bool), params,
+    )
+    fn = grow_tree_depthwise if growth == "depthwise" else grow_tree
+    return fn(*args, num_bins=n_bins, max_leaves=max_leaves)
+
+
+def test_structure_and_partition_consistency():
+    X_bin, grad, hess, n_bins = _setup()
+    tree, leaf_id = _grow("depthwise", X_bin, grad, hess, n_bins, 31)
+    nl = int(tree.num_leaves)
+    assert 2 <= nl <= 31
+    # returned row partition == the tree's own decision program
+    walked = np.asarray(predict_leaf_binned(tree, jnp.asarray(X_bin)))
+    np.testing.assert_array_equal(walked, np.asarray(leaf_id))
+    # leaf counts partition the data
+    lc = np.asarray(tree.leaf_count)[:nl]
+    assert lc.sum() == len(X_bin)
+    np.testing.assert_array_equal(
+        lc, np.bincount(np.asarray(leaf_id), minlength=nl)[:nl]
+    )
+    # child pointers are self-consistent: every node referenced once
+    li = nl - 1
+    children = np.concatenate(
+        [np.asarray(tree.left_child)[:li], np.asarray(tree.right_child)[:li]]
+    )
+    internal_refs = children[children >= 0]
+    leaf_refs = ~children[children < 0]
+    assert sorted(internal_refs) == list(range(1, li))  # all but root
+    assert sorted(leaf_refs) == list(range(nl))
+
+
+def test_leaf_budget_respected():
+    X_bin, grad, hess, n_bins = _setup(n=8000)
+    for budget in (4, 7, 15):
+        tree, _ = _grow("depthwise", X_bin, grad, hess, n_bins, budget,
+                        min_data_in_leaf=5)
+        assert int(tree.num_leaves) <= budget
+
+
+def test_max_depth_respected():
+    X_bin, grad, hess, n_bins = _setup(n=8000)
+    tree, _ = _grow("depthwise", X_bin, grad, hess, n_bins, 63,
+                    min_data_in_leaf=5, max_depth=3)
+    nl = int(tree.num_leaves)
+    assert nl <= 8  # 2^3
+    assert int(np.asarray(tree.leaf_depth)[:nl].max()) <= 3
+
+
+def test_no_split_possible_gives_stump():
+    n = 500
+    X_bin = np.zeros((n, 3), np.uint8)  # constant features: no split
+    grad = np.random.RandomState(0).randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    tree, leaf_id = _grow("depthwise", X_bin, grad, hess, 4, 15)
+    assert int(tree.num_leaves) == 1
+    assert np.all(np.asarray(leaf_id) == 0)
+
+
+def test_depthwise_matches_leafwise_when_unconstrained():
+    """With a budget that never binds (every positive-gain split fits),
+    both growers take exactly the same split set — same leaves, same
+    per-row outputs (order/indexing may differ)."""
+    X_bin, grad, hess, n_bins = _setup(n=2000, f=4, n_bins=8)
+    lw_tree, lw_leaf = _grow("leafwise", X_bin, grad, hess, n_bins, 127,
+                             min_data_in_leaf=200)
+    dw_tree, dw_leaf = _grow("depthwise", X_bin, grad, hess, n_bins, 127,
+                             min_data_in_leaf=200)
+    assert int(lw_tree.num_leaves) == int(dw_tree.num_leaves)
+    out_lw = np.asarray(lw_tree.leaf_value)[np.asarray(lw_leaf)]
+    out_dw = np.asarray(dw_tree.leaf_value)[np.asarray(dw_leaf)]
+    np.testing.assert_allclose(out_lw, out_dw, rtol=1e-5, atol=1e-6)
+
+
+def test_depthwise_end_to_end_accuracy():
+    rng = np.random.RandomState(7)
+    X = rng.randn(4000, 10)
+    w = rng.randn(10)
+    y = (X @ w + 0.4 * rng.randn(4000) > 0).astype(float)
+    aucs = {}
+    for growth in ("leafwise", "depthwise"):
+        bst = lgb.train(
+            {"objective": "binary", "metric": "auc", "num_leaves": 31,
+             "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+             "tree_growth": growth, "verbose": 0},
+            lgb.Dataset(X[:3000], label=y[:3000]),
+            num_boost_round=30, verbose_eval=False,
+        )
+        pred = bst.predict(X[3000:])
+        pos, neg = pred[y[3000:] == 1], pred[y[3000:] == 0]
+        aucs[growth] = np.mean(pos[:, None] > neg[None, :])
+    assert aucs["depthwise"] > 0.93
+    assert abs(aucs["depthwise"] - aucs["leafwise"]) < 0.02
+
+
+def test_depthwise_model_save_load_roundtrip(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.randn(1500, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
+         "min_sum_hessian_in_leaf": 1.0, "tree_growth": "depthwise",
+         "verbose": 0},
+        lgb.Dataset(X, label=y), num_boost_round=5, verbose_eval=False,
+    )
+    path = str(tmp_path / "dw.txt")
+    bst.save_model(path)
+    back = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(back.predict(X), bst.predict(X), atol=1e-5)

@@ -108,3 +108,45 @@ def test_dataset_uses_native_encode():
         del os.environ["LIGHTGBM_TPU_NO_NATIVE"]
         nat._lib, nat._tried = None, False
     np.testing.assert_array_equal(ds1.X_bin, ds2.X_bin)
+
+
+def test_native_rejects_malformed_rows(tmp_path):
+    """Ragged/garbage rows must NOT parse silently: the native parser
+    refuses and the strict python reader raises (review fix)."""
+    p = str(tmp_path / "ragged.csv")
+    with open(p, "w") as fh:
+        fh.write("1,2\n1,2,3\n")
+    assert native.parse_file(p, "csv", False) is None
+    p2 = str(tmp_path / "garbage.csv")
+    with open(p2, "w") as fh:
+        fh.write("1,2.5\n1,1.5abc\n")
+    assert native.parse_file(p2, "csv", False) is None
+    with pytest.raises(Exception):
+        parse_file(p2)
+
+
+def test_native_rejects_qid_libsvm(tmp_path):
+    """'qid:' tokens must not silently corrupt feature 0 (review fix)."""
+    p = str(tmp_path / "rank.svm")
+    with open(p, "w") as fh:
+        fh.write("2 qid:1 1:0.5 2:0.3\n1 qid:1 1:0.1\n")
+    assert native.parse_file(p, "libsvm", False) is None
+
+
+def test_native_csv_with_stray_tab(tmp_path):
+    """A tab inside a CSV must not flip the separator (review fix)."""
+    p = str(tmp_path / "tab.csv")
+    with open(p, "w") as fh:
+        fh.write("1,2.5,3\n0,1.5,4\n")
+    m = native.parse_file(p, "csv", False)
+    assert m.shape == (2, 3)
+    np.testing.assert_allclose(m[0], [1, 2.5, 3])
+
+
+def test_native_short_rows_pad_nan(tmp_path):
+    p = str(tmp_path / "short.csv")
+    with open(p, "w") as fh:
+        fh.write("1,2,3\n4,5\n")
+    m = native.parse_file(p, "csv", False)
+    assert m.shape == (2, 3)
+    assert np.isnan(m[1, 2])
