@@ -149,10 +149,24 @@ def ours_sec_per_tree(X, y) -> tuple[float, float]:
     obj = create_objective(cfg, ds.metadata, ds.num_data)
     booster = GBDT(cfg, ds, obj)
 
-    # warmup: first iteration compiles
+    # warmup: first iteration compiles.  If the Pallas histogram path
+    # fails on this backend, fall back to the segment_sum path rather
+    # than failing the whole bench.
     t0 = time.perf_counter()
-    booster.train_one_iter()
-    _ = np.asarray(booster._scores)  # force completion (async dispatch)
+    try:
+        booster.train_one_iter()
+        _ = np.asarray(booster._scores)  # force completion (async dispatch)
+    except Exception as e:
+        # only retry when the Pallas matmul path was actually in play —
+        # otherwise the same code would just fail twice
+        if not (cfg.tree_growth == "depthwise" and booster._use_matmul_hist()):
+            raise
+        log(f"warmup failed ({type(e).__name__}: {str(e)[:300]}); "
+            "retrying with hist_impl=segment")
+        cfg.hist_impl = "segment"
+        booster = GBDT(cfg, ds, obj)
+        booster.train_one_iter()
+        _ = np.asarray(booster._scores)
     log(f"compile + first tree: {time.perf_counter() - t0:.1f}s")
 
     done = 0

@@ -197,6 +197,11 @@ class Config:
     # much faster on TPU) while keeping the num_leaves budget via best-gain
     # masking at the final level.
     tree_growth: str = "leafwise"
+    # TPU extension: histogram implementation for depthwise growth.
+    # "segment" = jax.ops.segment_sum scatter; "matmul" = leaf-sorted MXU
+    # one-hot matmul Pallas kernel (ops/pallas_histogram.py); "auto" picks
+    # matmul on TPU backends, segment elsewhere.
+    hist_impl: str = "auto"
 
     # ---- boosting (BoostingConfig, config.h:192-221)
     boosting_type: str = "gbdt"
@@ -278,6 +283,8 @@ class Config:
             raise ValueError(f"Unknown boosting_type: {self.boosting_type!r}")
         if self.tree_growth not in ("leafwise", "depthwise"):
             raise ValueError(f"Unknown tree_growth: {self.tree_growth!r}")
+        if self.hist_impl not in ("auto", "segment", "matmul"):
+            raise ValueError(f"Unknown hist_impl: {self.hist_impl!r}")
         if self.max_bin < 2:
             raise ValueError("max_bin must be >= 2")
 

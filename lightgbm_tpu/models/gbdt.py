@@ -125,6 +125,7 @@ class GBDT:
                     grow_tree_depthwise,
                     num_bins=self._num_bins,
                     max_leaves=self.max_leaves,
+                    hist_fn=self._depthwise_hist_fn(),
                 )
             return functools.partial(
                 grow_tree, num_bins=self._num_bins, max_leaves=self.max_leaves
@@ -156,7 +157,26 @@ class GBDT:
             num_bins=self._num_bins,
             max_leaves=self.max_leaves,
             growth=self.config.tree_growth,
+            sorted_hist=(
+                self.config.tree_growth == "depthwise"
+                and self._use_matmul_hist()
+            ),
         )
+
+    def _use_matmul_hist(self) -> bool:
+        impl = self.config.hist_impl
+        return impl == "matmul" or (
+            impl == "auto" and jax.default_backend() == "tpu"
+        )
+
+    def _depthwise_hist_fn(self):
+        """Histogram implementation for depthwise growth (config.hist_impl):
+        the leaf-sorted MXU matmul kernel on TPU, segment_sum elsewhere."""
+        if self._use_matmul_hist():
+            from ..ops.pallas_histogram import make_sorted_hist_fn
+
+            return make_sorted_hist_fn(self._num_bins)
+        return None  # grower's default segment_sum path
 
     def add_valid_dataset(self, valid_set: BinnedDataset, name: str) -> None:
         """GBDT::AddValidDataset (gbdt.cpp:124-140)."""

@@ -41,7 +41,7 @@ from .mesh import ROW_AXIS, row_padded_grower
 
 def make_data_parallel_grower(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
-    growth: str = "leafwise",
+    growth: str = "leafwise", sorted_hist: bool = False,
 ):
     """Build a grow(bins_T, grad, hess, bag_mask, feature_mask,
     num_bins_per_feature, is_categorical, params) -> (tree, leaf_id)
@@ -57,12 +57,22 @@ def make_data_parallel_grower(
     def hist_psum(bins_T, grad, hess, mask):
         return jax.lax.psum(hist_local(bins_T, grad, hess, mask), axis)
 
+    if sorted_hist:
+        from ..ops.pallas_histogram import make_sorted_hist_fn
+
+        local_level_hist = make_sorted_hist_fn(num_bins)
+    else:
+        def local_level_hist(bins_T, leaf_id, grad, hess, mask, num_leaves):
+            return histogram_by_leaf(
+                bins_T, leaf_id, grad, hess, mask,
+                num_bins=num_bins, num_leaves=num_leaves,
+            )
+
     def level_hist_psum(bins_T, leaf_id, grad, hess, mask, num_leaves):
-        local = histogram_by_leaf(
-            bins_T, leaf_id, grad, hess, mask,
-            num_bins=num_bins, num_leaves=num_leaves,
+        return jax.lax.psum(
+            local_level_hist(bins_T, leaf_id, grad, hess, mask, num_leaves),
+            axis,
         )
-        return jax.lax.psum(local, axis)
 
     def reduce_sum(x):
         return jax.lax.psum(x, axis)
