@@ -127,8 +127,44 @@ def run_train(cfg: Config) -> GBDT:
     best_iter: Dict[tuple, int] = {}
     best_model_iter = 0
 
+    profiler_ctx = None
+    if cfg.profile:
+        # TPU-native replacement for the reference's per-iteration
+        # wall-clock logging (application.cpp:228-235): a full
+        # jax.profiler trace with per-kernel XLA cost breakdown
+        import jax
+
+        jax.profiler.start_trace(cfg.profile_dir)
+        profiler_ctx = cfg.profile_dir
+
     start = time.perf_counter()
     stop_early = False
+    try:
+        stop_early = _train_loop(cfg, booster, valid_names, best_score,
+                                 best_iter, start)
+    finally:
+        if profiler_ctx is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+            Log.info(f"Saved profiler trace to {profiler_ctx}")
+    if stop_early:
+        best_model_iter = max(best_iter.values()) + 1
+
+    # slice counts iterations from the model start, so prepended
+    # init-model trees are part of the budget (gbdt.cpp:589-592)
+    num_iteration = (
+        booster.num_init_iteration + best_model_iter if stop_early else -1
+    )
+    booster.save_model_to_file(cfg.output_model, num_iteration)
+    Log.info(f"Finished training, saved model to {cfg.output_model}")
+    return booster
+
+
+def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
+                best_score: Dict, best_iter: Dict, start: float) -> bool:
+    """The iteration loop (application.cpp:223-239); returns True when
+    early stopping fired."""
     for it in range(cfg.num_iterations):
         finished = booster.train_one_iter()
         Log.info(
@@ -149,26 +185,16 @@ def run_train(cfg: Config) -> GBDT:
                 if rows and all(
                     it - best_iter[k] >= cfg.early_stopping_round for k in best_iter
                 ):
-                    best_model_iter = max(best_iter.values()) + 1
                     Log.info(
                         f"Early stopping at iteration {it + 1}, the best "
-                        f"iteration round is {best_model_iter}"
+                        f"iteration round is {max(best_iter.values()) + 1}"
                     )
-                    stop_early = True
-                    break
+                    return True
         if finished:
             Log.info("Stopped training because there are no more leaves "
                      "that meet the split requirements.")
             break
-
-    # slice counts iterations from the model start, so prepended
-    # init-model trees are part of the budget (gbdt.cpp:589-592)
-    num_iteration = (
-        booster.num_init_iteration + best_model_iter if stop_early else -1
-    )
-    booster.save_model_to_file(cfg.output_model, num_iteration)
-    Log.info(f"Finished training, saved model to {cfg.output_model}")
-    return booster
+    return False
 
 
 def run_predict(cfg: Config) -> None:

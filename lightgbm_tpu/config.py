@@ -231,6 +231,11 @@ class Config:
     seed: int = 0
     num_threads: int = 0
 
+    # TPU extension (SURVEY 5.1): capture a jax.profiler trace of the
+    # training loop into profile_dir (viewable in TensorBoard/Perfetto).
+    profile: bool = False
+    profile_dir: str = "lightgbm_tpu_profile"
+
     def __post_init__(self):
         if not self.metric:
             self.metric = []

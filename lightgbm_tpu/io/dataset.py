@@ -169,16 +169,17 @@ class BinnedDataset:
         X: np.ndarray,
         metadata: Metadata,
         config: Optional[Config] = None,
-        bin_mappers: Optional[List[BinMapper]] = None,
         categorical_features: Sequence[int] = (),
         feature_names: Optional[List[str]] = None,
+        mappers_all: Optional[List[BinMapper]] = None,
     ) -> "BinnedDataset":
-        """Bin a dense feature matrix.  When ``bin_mappers`` is given the
-        dataset is aligned to them (valid-set path)."""
+        """Bin a dense feature matrix.  ``mappers_all`` (one BinMapper per
+        column, trivial ones dropped here) skips bin finding — used by the
+        distributed loader where mappers must be rank-consistent."""
         config = config or Config()
         X = np.ascontiguousarray(X, dtype=np.float64)
         n, f_total = X.shape
-        if bin_mappers is None:
+        if mappers_all is None:
             # sample rows for bin finding (config.h:108 default 50k)
             cnt = min(n, int(config.bin_construct_sample_cnt))
             rng = np.random.RandomState(config.data_random_seed)
@@ -193,20 +194,16 @@ class BinnedDataset:
                 max_bin=config.max_bin,
                 categorical_features=categorical_features,
             )
-        else:
-            mappers_all = None
-
-        if mappers_all is not None:
-            used_map = np.full(f_total, -1, dtype=np.int64)
-            used_mappers: List[BinMapper] = []
-            for j, m in enumerate(mappers_all):
-                if not m.is_trivial:
-                    used_map[j] = len(used_mappers)
-                    used_mappers.append(m)
-        else:
-            # align to given mappers: caller passes used_feature_map too via
-            # align_with(); here assume mappers correspond to all columns used
-            raise ValueError("use align_with() for pre-binned mappers")
+        if len(mappers_all) != f_total:
+            raise ValueError(
+                f"mappers_all covers {len(mappers_all)} columns, data has {f_total}"
+            )
+        used_map = np.full(f_total, -1, dtype=np.int64)
+        used_mappers: List[BinMapper] = []
+        for j, m in enumerate(mappers_all):
+            if not m.is_trivial:
+                used_map[j] = len(used_mappers)
+                used_mappers.append(m)
 
         dtype = np.uint8 if max((m.num_bin for m in used_mappers), default=1) <= 256 else np.uint16
         X_bin = np.empty((n, len(used_mappers)), dtype=dtype)
@@ -241,16 +238,26 @@ class BinnedDataset:
         path: str,
         config: Optional[Config] = None,
         reference: Optional["BinnedDataset"] = None,
+        rank: Optional[int] = None,
     ) -> "BinnedDataset":
-        """Load + bin a text data file (or its binary cache)."""
+        """Load + bin a text data file (or its binary cache).
+
+        With ``config.num_machines > 1`` and ``is_pre_partition=false``,
+        every rank reads the file and keeps only its shared-seed random
+        row partition — query-granular for ranked data
+        (dataset_loader.cpp:500-605).  ``rank`` defaults to
+        ``jax.process_index()``."""
         config = config or Config()
         bin_path = path + ".bin"
-        if os.path.exists(bin_path) and reference is None:
+        if os.path.exists(bin_path) and reference is None and config.num_machines <= 1:
             try:
                 return BinnedDataset.load_binary(bin_path)
             except Exception:
                 pass
         raw, names = parse_file(path, has_header=config.has_header)
+        side = Metadata.load_side_files(path)
+
+        # ---- resolve column roles on the FULL file (dataset_loader.cpp:23-160)
         label_col = _resolve_column(config.label_column, names)
         if label_col is None:
             label_col = 0
@@ -259,7 +266,6 @@ class BinnedDataset:
 
         n = raw.shape[0]
         label = raw[:, label_col].astype(np.float32)
-        side = Metadata.load_side_files(path)
         weight_col = _resolve_column(config.weight_column, names)
         group_col = _resolve_column(config.group_column, names)
         weights = side.get("weights")
@@ -290,12 +296,60 @@ class BinnedDataset:
             query_boundaries=qb,
             init_score=side.get("init_score"),
         )
+
+        distributed = config.num_machines > 1 and not config.is_pre_partition
+        mappers_all = None
+        if distributed:
+            from .distributed import (
+                distributed_find_bin_mappers,
+                partition_rows,
+            )
+            import jax
+
+            if rank is None:
+                rank = jax.process_index()
+            # query-granular partition uses the FULL metadata's boundaries
+            # (side file OR group_column, dataset_loader.cpp:560-605)
+            keep = partition_rows(
+                n, rank, config.num_machines,
+                seed=config.data_random_seed,
+                query_boundaries=meta.query_boundaries,
+            )
+            # Bin mappers must be rank-consistent.  Since is_pre_partition=
+            # false means every rank parsed the FULL file, the shared-seed
+            # sample over the full data gives identical mappers everywhere
+            # with zero communication; with multiple attached processes the
+            # feature-sharded finder + mapper allgather is used instead
+            # (dataset_loader.cpp:692-755).
+            cnt = min(n, int(config.bin_construct_sample_cnt))
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_idx = (
+                np.arange(n) if cnt >= n
+                else np.sort(rng.choice(n, size=cnt, replace=False))
+            )
+            if jax.process_count() > 1:
+                mappers_all = distributed_find_bin_mappers(
+                    X[sample_idx], rank, config.num_machines,
+                    max_bin=config.max_bin, categorical_features=cat_inner,
+                    total_sample_cnt=len(sample_idx),
+                )
+            else:
+                mappers_all = find_bin_mappers(
+                    X[sample_idx], total_sample_cnt=len(sample_idx),
+                    max_bin=config.max_bin, categorical_features=cat_inner,
+                )
+            X = X[keep]
+            meta = meta.subset(keep)
+
         if reference is not None:
             return reference.align_with(X, meta)
         ds = BinnedDataset.from_matrix(
-            X, meta, config, categorical_features=cat_inner, feature_names=fnames
+            X, meta, config, categorical_features=cat_inner,
+            feature_names=fnames, mappers_all=mappers_all,
         )
-        if config.is_save_binary_file:
+        # the binary cache holds FULL-file contents only — a partitioned
+        # rank subset must never poison the shared cache path
+        if config.is_save_binary_file and not distributed:
             ds.save_binary(bin_path)
         return ds
 
