@@ -145,8 +145,11 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
                 best_score[i] = score
                 best_iter[i] = env.iteration
                 best_score_list[i] = env.evaluation_result_list
-            # never early-stop on the training metric (callback.py:171)
-            elif data_name == "training":
+            # never early-stop on the training metric (callback.py:171).
+            # engine.train renames the train set to the user's valid_names
+            # entry, so compare against the model's train_data_name rather
+            # than the literal default.
+            elif data_name == getattr(env.model, "train_data_name", "training"):
                 continue
             elif env.iteration - best_iter[i] >= stopping_rounds:
                 if env.model is not None:

@@ -83,10 +83,14 @@ def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],
         s = scores if gbdt.num_class > 1 else scores[0]
         for m in metrics:
             if hasattr(m, "eval_multi"):
-                for k, v in zip(m.eval_at, m.eval_multi(s)):
+                # print every position, but early stopping judges a
+                # multi-position metric only by its LAST position, like
+                # the reference (gbdt.cpp OutputMetric: test_scores.back())
+                values = m.eval_multi(s)
+                for k, v in zip(m.eval_at, values):
                     Log.info(f"Iteration: {iter_num}, {name} {m.name}@{k} : {v:g}")
-                    if data_idx > 0:
-                        rows.append((data_idx, f"{m.name}@{k}", v, m.bigger_is_better))
+                if data_idx > 0 and len(values):
+                    rows.append((data_idx, m.name, values[-1], m.bigger_is_better))
             else:
                 v = m.eval(s)
                 Log.info(f"Iteration: {iter_num}, {name} {m.name} : {v:g}")
@@ -138,18 +142,19 @@ def run_train(cfg: Config) -> GBDT:
         profiler_ctx = cfg.profile_dir
 
     start = time.perf_counter()
-    stop_early = False
+    stop_iter = None
     try:
-        stop_early = _train_loop(cfg, booster, valid_names, best_score,
-                                 best_iter, start)
+        stop_iter = _train_loop(cfg, booster, valid_names, best_score,
+                                best_iter, start)
     finally:
         if profiler_ctx is not None:
             import jax
 
             jax.profiler.stop_trace()
             Log.info(f"Saved profiler trace to {profiler_ctx}")
+    stop_early = stop_iter is not None
     if stop_early:
-        best_model_iter = max(best_iter.values()) + 1
+        best_model_iter = stop_iter + 1
 
     # slice counts iterations from the model start, so prepended
     # init-model trees are part of the budget (gbdt.cpp:589-592)
@@ -162,9 +167,14 @@ def run_train(cfg: Config) -> GBDT:
 
 
 def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
-                best_score: Dict, best_iter: Dict, start: float) -> bool:
-    """The iteration loop (application.cpp:223-239); returns True when
-    early stopping fired."""
+                best_score: Dict, best_iter: Dict, start: float):
+    """The iteration loop (application.cpp:223-239); returns the best
+    0-based iteration when early stopping fired, else None.
+
+    Early stopping matches the reference (gbdt.cpp:336-349): it fires as
+    soon as ANY (valid set, metric) pair has gone early_stopping_round
+    iterations without improving, and the model is truncated to THAT
+    pair's best iteration — not the max over all pairs."""
     for it in range(cfg.num_iterations):
         finished = booster.train_one_iter()
         Log.info(
@@ -182,19 +192,17 @@ def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
                     )
                     if better:
                         best_score[key], best_iter[key] = v, it
-                if rows and all(
-                    it - best_iter[k] >= cfg.early_stopping_round for k in best_iter
-                ):
-                    Log.info(
-                        f"Early stopping at iteration {it + 1}, the best "
-                        f"iteration round is {max(best_iter.values()) + 1}"
-                    )
-                    return True
+                    elif it - best_iter[key] >= cfg.early_stopping_round:
+                        Log.info(
+                            f"Early stopping at iteration {it + 1}, the best "
+                            f"iteration round is {best_iter[key] + 1}"
+                        )
+                        return best_iter[key]
         if finished:
             Log.info("Stopped training because there are no more leaves "
                      "that meet the split requirements.")
             break
-    return False
+    return None
 
 
 def run_predict(cfg: Config) -> None:

@@ -12,6 +12,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+from .log import Log
+
+_warned_unknown_params: set = set()
+
 # Alias table mirrors reference config.h:320-410 (KeyAliasTransform):
 # an alias never overrides an explicitly-given canonical key.
 PARAM_ALIASES: Dict[str, str] = {
@@ -258,7 +262,14 @@ class Config:
             if k == "output_freq":
                 k = "metric_freq"
             if k not in known:
-                continue  # unknown keys are ignored (logged by callers)
+                # reference warns on unrecognized params (config.cpp
+                # unknown-param path) — a typo'd key must not train
+                # silently with the default value.  Warn once per key:
+                # from_dict runs several times per training session.
+                if k not in _warned_unknown_params:
+                    _warned_unknown_params.add(k)
+                    Log.warning(f"Unknown parameter: {k}")
+                continue
             f = known[k]
             if f.type in ("int", int):
                 kwargs[k] = int(float(v))

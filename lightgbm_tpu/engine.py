@@ -74,22 +74,25 @@ def train(
             vs.reference = train_set
         booster.add_valid(vs, name)
 
-    cbs = set(callbacks or [])
+    # dedupe while preserving insertion order: callbacks sharing an order
+    # value (user callbacks default to 0) must run in registration order
+    # like the reference's list, not in hash order
+    cbs = list(dict.fromkeys(callbacks or []))
     if verbose_eval is True:
-        cbs.add(callback.print_evaluation())
+        cbs.append(callback.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval:
-        cbs.add(callback.print_evaluation(verbose_eval))
+        cbs.append(callback.print_evaluation(verbose_eval))
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback.early_stopping(early_stopping_rounds, verbose=bool(verbose_eval)))
+        cbs.append(callback.early_stopping(early_stopping_rounds, verbose=bool(verbose_eval)))
     if learning_rates is not None:
-        cbs.add(callback.reset_parameter(learning_rate=learning_rates))
+        cbs.append(callback.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
-        cbs.add(callback.record_evaluation(evals_result))
+        cbs.append(callback.record_evaluation(evals_result))
 
-    callbacks_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
-    callbacks_after = cbs - callbacks_before
-    callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
-    callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in callbacks_before:
@@ -252,17 +255,17 @@ def cv(
         cvfolds.append(bst)
 
     results = collections.defaultdict(list)
-    cbs = set(callbacks or [])
+    cbs = list(dict.fromkeys(callbacks or []))  # ordered dedupe, see train()
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback.early_stopping(early_stopping_rounds, verbose=False))
+        cbs.append(callback.early_stopping(early_stopping_rounds, verbose=False))
     if verbose_eval is True:
-        cbs.add(callback.print_evaluation(show_stdv=show_stdv))
+        cbs.append(callback.print_evaluation(show_stdv=show_stdv))
     elif isinstance(verbose_eval, int) and verbose_eval:
-        cbs.add(callback.print_evaluation(verbose_eval, show_stdv))
-    callbacks_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
-    callbacks_after = cbs - callbacks_before
-    callbacks_before = sorted(callbacks_before, key=lambda cb: getattr(cb, "order", 0))
-    callbacks_after = sorted(callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+        cbs.append(callback.print_evaluation(verbose_eval, show_stdv))
+    callbacks_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     for i in range(num_boost_round):
         for cb in callbacks_before:

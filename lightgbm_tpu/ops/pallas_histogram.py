@@ -66,8 +66,10 @@ def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, c
 
 
 def _pad_pow(b: int) -> int:
-    """Bin axis padded to a lane multiple (128/256)."""
-    return 128 if b <= 128 else 256
+    """Bin axis padded up to a lane multiple (128).  Must never round
+    DOWN: max_bin > 256 is legal (uint16 bins), and a capped pad would
+    silently drop rows whose bin >= cap from the histogram."""
+    return ((b + 127) // 128) * 128
 
 
 @functools.partial(

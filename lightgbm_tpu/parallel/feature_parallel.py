@@ -25,7 +25,10 @@ from ..learners.serial import grow_tree
 from ..ops.histogram import histogram_feature_major
 from ..ops.split import SplitResult, find_best_split
 
-_INT_MAX = jnp.int32(2**31 - 1)
+# Plain Python int (weakly typed in jnp ops): a module-level jnp constant
+# would initialize the default JAX backend at import time, which hangs
+# when a TPU plugin (axon) claims the platform before the caller pins it.
+_INT_MAX = 2**31 - 1
 
 
 def combine_split_infos(r: SplitResult, axis: str) -> SplitResult:

@@ -67,3 +67,12 @@ def test_invalid_config_rejected():
         Config.from_dict({"tree_learner": "bogus"})
     with pytest.raises(ValueError):
         Config.from_dict({"boosting_type": "bogus"})
+
+
+def test_unknown_param_warns(capsys):
+    """A typo'd key must warn, not silently train with the default
+    (reference src/io/config.cpp unknown-param warning)."""
+    c = Config.from_dict({"num_leavs": "255", "objective": "binary"})
+    err = capsys.readouterr().err
+    assert "Unknown parameter: num_leavs" in err
+    assert c.num_leaves == 127  # default untouched
