@@ -206,6 +206,13 @@ class Config:
     # one-hot matmul Pallas kernel (ops/pallas_histogram.py); "auto" picks
     # matmul on TPU backends, segment elsewhere.
     hist_impl: str = "auto"
+    # TPU extension: histogram accumulation dtype.  The reference always
+    # keeps sum_gradients/sum_hessians in double (include/LightGBM/
+    # bin.h:21-22, split_info.hpp:24-40); float32 is the TPU-fast default
+    # here, float64 restores the reference's accumulation exactly (and
+    # makes parallel == serial trees bit-identical) at the cost of
+    # emulated f64 on TPU hardware.
+    hist_dtype: str = "float32"  # float32 | float64
 
     # ---- boosting (BoostingConfig, config.h:192-221)
     boosting_type: str = "gbdt"
@@ -301,6 +308,8 @@ class Config:
             raise ValueError(f"Unknown tree_growth: {self.tree_growth!r}")
         if self.hist_impl not in ("auto", "segment", "matmul"):
             raise ValueError(f"Unknown hist_impl: {self.hist_impl!r}")
+        if self.hist_dtype not in ("float32", "float64"):
+            raise ValueError(f"Unknown hist_dtype: {self.hist_dtype!r}")
         if self.max_bin < 2:
             raise ValueError("max_bin must be >= 2")
 

@@ -17,8 +17,11 @@ fixed-shape ``lax.fori_loop``:
   Left child keeps the parent's leaf index, right child gets the next
   fresh index — the reference's exact leaf numbering (tree.cpp:78-89),
   so trees are comparable node-for-node.
-* the heavy branch runs under ``lax.cond`` so exhausted trees cost
-  nothing per remaining step.
+* every store in the split step is MASKED on the split-fired predicate
+  (rather than branching with ``lax.cond``, whose pass-through branch
+  forced XLA to copy the histogram buffer each iteration), so all state
+  updates stay in place and an exhausted tree simply no-ops its
+  remaining steps.
 
 The data-parallel learner wraps this same step with psum'd histograms
 (learners/data_parallel.py); determinism of argmax tie-breaks keeps
@@ -70,10 +73,10 @@ class _GrowState(NamedTuple):
     tree: Tree
 
 
-def _empty_best(L: int) -> SplitResult:
-    z = jnp.zeros(L, jnp.float32)
+def _empty_best(L: int, dtype=jnp.float32) -> SplitResult:
+    z = jnp.zeros(L, dtype)
     return SplitResult(
-        gain=jnp.full(L, K_MIN_SCORE, jnp.float32),
+        gain=jnp.full(L, K_MIN_SCORE, dtype),
         feature=jnp.full(L, -1, jnp.int32),
         threshold=jnp.zeros(L, jnp.int32),
         left_sum_grad=z,
@@ -89,6 +92,68 @@ def _empty_best(L: int) -> SplitResult:
 
 def _set_best(best: SplitResult, i, new: SplitResult) -> SplitResult:
     return SplitResult(*[b.at[i].set(n) for b, n in zip(best, new)])
+
+
+def _hist_tiers(n: int):
+    """Static gather capacities for the smaller-child histogram: a few
+    fractions of n, rounded up to lanes, deduped, smallest-first use."""
+    caps = []
+    for frac in (4, 8, 16, 32, 64, 128, 256):
+        cap = max(512, ((-(-n // frac) + 127) // 128) * 128)
+        if cap < n and cap not in caps:
+            caps.append(cap)
+    return tuple(caps)
+
+
+def _gathered_hist(hist_fn, bins_T, grad, hess, in_small, cap: int):
+    """Gather the rows where ``in_small`` into a [cap]-row buffer (order
+    preserved via cumsum positions — one O(n) pass, no sort) and run the
+    histogram kernel over the buffer only."""
+    n = grad.shape[0]
+    pos = jnp.cumsum(in_small.astype(jnp.int32)) - 1
+    # rows beyond cap (excluded by the exact-count tier gate; the guard
+    # is belt-and-braces) and rows outside the child land in the dump slot
+    dest = jnp.where(in_small & (pos < cap), pos, cap)
+    idx = (
+        jnp.full(cap + 1, n, jnp.int32)
+        .at[dest]
+        .set(jnp.arange(n, dtype=jnp.int32))[:cap]
+    )
+    valid = idx < n
+    idxc = jnp.minimum(idx, n - 1)
+    return hist_fn(
+        jnp.take(bins_T, idxc, axis=1),
+        grad[idxc],
+        hess[idxc],
+        valid.astype(grad.dtype),
+    )
+
+
+def _smaller_child_hist(hist_fn, bins_T, grad, hess, in_small, cnt_small, tiers):
+    """Histogram of the smaller child without touching all rows — the
+    reference's ordered-gradients trick (serial_tree_learner.cpp:259-315)
+    re-cast for static shapes: pick the smallest capacity tier that fits
+    the child (lax.cond chain) and gather its rows there; fall back to
+    the full masked pass for large children.  Cuts the per-split
+    histogram work from O(n * F) to O(|smaller child| * F)."""
+
+    def full(_):
+        return hist_fn(bins_T, grad, hess, in_small.astype(grad.dtype))
+
+    fn = full
+    for cap in sorted(tiers, reverse=True):
+        def tiered(_, cap=cap, nxt=fn):
+            return jax.lax.cond(
+                cnt_small <= cap,
+                lambda __: _gathered_hist(
+                    hist_fn, bins_T, grad, hess, in_small, cap
+                ),
+                nxt,
+                None,
+            )
+
+        fn = tiered
+    return fn(None)
 
 
 def default_search_fn(
@@ -145,6 +210,7 @@ def grow_tree(
     """
     F, n = bins_T.shape
     L = max_leaves
+    tiers = _hist_tiers(n)
 
     if hist_fn is None:
         hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
@@ -166,21 +232,33 @@ def grow_tree(
     if reduce_fn is not None:
         sum_g0, sum_h0, cnt0 = reduce_fn(sum_g0), reduce_fn(sum_h0), reduce_fn(cnt0)
 
-    # hist0's feature extent may be a shard of F (feature-parallel learner)
+    # hist0's feature extent may be a shard of F (feature-parallel
+    # learner); accumulation dtype follows grad/hess — float64 when
+    # Config.hist_dtype asks for the reference's double accumulation
+    # (include/LightGBM/bin.h:21-22)
+    acc_dt = hist0.dtype
     state = _GrowState(
         leaf_id=jnp.zeros(n, jnp.int32),
-        hists=jnp.zeros((L,) + hist0.shape, jnp.float32).at[0].set(hist0),
-        sum_g=jnp.zeros(L, jnp.float32).at[0].set(sum_g0),
-        sum_h=jnp.zeros(L, jnp.float32).at[0].set(sum_h0),
-        cnt=jnp.zeros(L, jnp.float32).at[0].set(cnt0),
+        hists=jnp.zeros((L,) + hist0.shape, acc_dt).at[0].set(hist0),
+        sum_g=jnp.zeros(L, acc_dt).at[0].set(sum_g0),
+        sum_h=jnp.zeros(L, acc_dt).at[0].set(sum_h0),
+        cnt=jnp.zeros(L, acc_dt).at[0].set(cnt0),
         best=_set_best(
-            _empty_best(L), 0, best_for(hist0, sum_g0, sum_h0, cnt0, jnp.int32(0))
+            _empty_best(L, acc_dt),
+            0,
+            best_for(hist0, sum_g0, sum_h0, cnt0, jnp.int32(0)),
         ),
         tree=empty_tree(L),
     )
 
-    def split_branch(args):
-        state, step, best_leaf = args
+    def split_branch(state, step, best_leaf, do_split):
+        """One split step with MASKED writes: when ``do_split`` is false
+        every store preserves the old value, so the state round-trips
+        unchanged.  An earlier version wrapped this in lax.cond with an
+        identity branch; XLA's copy insertion then duplicated the whole
+        [L, F, B, 3] histogram buffer every iteration (O(L^2*F*B) traffic
+        per tree), which dominated the run time.  Masked straight-line
+        writes keep every buffer update in place."""
         t = state.tree
         node = step
         new_leaf = step + 1
@@ -193,7 +271,9 @@ def grow_tree(
         vals = bins_T[f].astype(jnp.int32)
         go_left = jnp.where(is_cat, vals == thr, vals <= thr)
         in_leaf = state.leaf_id == best_leaf
-        leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
+        leaf_id = jnp.where(
+            do_split & in_leaf & ~go_left, new_leaf, state.leaf_id
+        )
 
         lsg = state.best.left_sum_grad[best_leaf]
         lsh = state.best.left_sum_hess[best_leaf]
@@ -202,16 +282,41 @@ def grow_tree(
         rsh = state.best.right_sum_hess[best_leaf]
         rc = state.best.right_count[best_leaf]
 
-        # ---- smaller-child histogram from data; sibling by subtraction
+        # ---- smaller-child histogram from data; sibling by subtraction.
+        # The tier gate needs an EXACT count (the f32 histogram count
+        # channel undercounts past 2^24 rows) that is also identical on
+        # every shard (the tier branches may contain collectives): an
+        # int32 sum of the local membership mask, allreduced when the
+        # rows are sharded.
         smaller_is_left = lc <= rc
         target = jnp.where(smaller_is_left, best_leaf, new_leaf)
-        mask_small = bag_mask * (leaf_id == target)
-        h_small = hist_fn(bins_T, grad, hess, mask_small)
-        h_parent = state.hists[best_leaf]
+        in_small = (leaf_id == target) & (bag_mask > 0)
+        cnt_small = jnp.sum(in_small.astype(jnp.int32))
+        if reduce_fn is not None:
+            cnt_small = reduce_fn(cnt_small)
+        h_small = _smaller_child_hist(
+            hist_fn, bins_T, grad, hess, in_small, cnt_small, tiers
+        )
+        # read the two slots BEFORE the in-place updates, behind a
+        # barrier so the reads can't fuse into the update computation —
+        # otherwise XLA's copy insertion duplicates the whole buffer
+        h_parent, h_prev_new = jax.lax.optimization_barrier(
+            (state.hists[best_leaf], state.hists[new_leaf])
+        )
         h_large = h_parent - h_small
         h_left = jnp.where(smaller_is_left, h_small, h_large)
         h_right = jnp.where(smaller_is_left, h_large, h_small)
-        hists = state.hists.at[best_leaf].set(h_left).at[new_leaf].set(h_right)
+        # materialize once: the buffer update below and the child split
+        # searches must consume the SAME tensors — if the searches re-read
+        # slices of the pre-update buffer, it has to outlive the update
+        # and XLA copies the whole thing
+        h_left, h_right = jax.lax.optimization_barrier((h_left, h_right))
+        hists = (
+            state.hists.at[best_leaf]
+            .set(jnp.where(do_split, h_left, h_parent))
+            .at[new_leaf]
+            .set(jnp.where(do_split, h_right, h_prev_new))
+        )
 
         # ---- tree bookkeeping (Tree::Split, tree.cpp:52-96)
         parent = t.leaf_parent[best_leaf]
@@ -219,48 +324,67 @@ def grow_tree(
         pidx = jnp.maximum(parent, 0)
         was_left = t.left_child[pidx] == ~best_leaf
         left_child = t.left_child.at[pidx].set(
-            jnp.where(has_parent & was_left, node, t.left_child[pidx])
+            jnp.where(do_split & has_parent & was_left, node, t.left_child[pidx])
         )
         right_child = t.right_child.at[pidx].set(
-            jnp.where(has_parent & ~was_left, node, t.right_child[pidx])
+            jnp.where(do_split & has_parent & ~was_left, node, t.right_child[pidx])
         )
-        left_child = left_child.at[node].set(~best_leaf)
-        right_child = right_child.at[node].set(~new_leaf)
+        left_child = left_child.at[node].set(
+            jnp.where(do_split, ~best_leaf, left_child[node])
+        )
+        right_child = right_child.at[node].set(
+            jnp.where(do_split, ~new_leaf, right_child[node])
+        )
+
+        def m(arr, i, val):  # masked store: keep old value unless splitting
+            # cast explicitly: under hist_dtype=float64 the split stats
+            # are f64 while tree buffers stay f32
+            return arr.at[i].set(
+                jnp.where(do_split, val, arr[i]).astype(arr.dtype)
+            )
 
         depth_child = t.leaf_depth[best_leaf] + 1
         tree = t._replace(
-            num_leaves=t.num_leaves + 1,
-            split_feature=t.split_feature.at[node].set(f),
-            threshold_bin=t.threshold_bin.at[node].set(thr),
-            decision_type=t.decision_type.at[node].set(is_cat.astype(jnp.int32)),
+            num_leaves=t.num_leaves + do_split.astype(t.num_leaves.dtype),
+            split_feature=m(t.split_feature, node, f),
+            threshold_bin=m(t.threshold_bin, node, thr),
+            decision_type=m(t.decision_type, node, is_cat.astype(jnp.int32)),
             left_child=left_child,
             right_child=right_child,
-            split_gain=t.split_gain.at[node].set(state.best.gain[best_leaf]),
-            internal_value=t.internal_value.at[node].set(t.leaf_value[best_leaf]),
-            internal_count=t.internal_count.at[node].set(lc + rc),
-            leaf_value=t.leaf_value.at[best_leaf]
-            .set(state.best.left_output[best_leaf])
-            .at[new_leaf]
-            .set(state.best.right_output[best_leaf]),
-            leaf_count=t.leaf_count.at[best_leaf].set(lc).at[new_leaf].set(rc),
-            leaf_parent=t.leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node),
-            leaf_depth=t.leaf_depth.at[best_leaf]
-            .set(depth_child)
-            .at[new_leaf]
-            .set(depth_child),
+            split_gain=m(t.split_gain, node, state.best.gain[best_leaf]),
+            internal_value=m(t.internal_value, node, t.leaf_value[best_leaf]),
+            internal_count=m(t.internal_count, node, lc + rc),
+            leaf_value=m(
+                m(t.leaf_value, best_leaf, state.best.left_output[best_leaf]),
+                new_leaf,
+                state.best.right_output[best_leaf],
+            ),
+            leaf_count=m(m(t.leaf_count, best_leaf, lc), new_leaf, rc),
+            leaf_parent=m(m(t.leaf_parent, best_leaf, node), new_leaf, node),
+            leaf_depth=m(
+                m(t.leaf_depth, best_leaf, depth_child), new_leaf, depth_child
+            ),
         )
 
         # ---- child best splits (FindBestThresholds on the two new leaves)
         best_l = best_for(h_left, lsg, lsh, lc, depth_child)
         best_r = best_for(h_right, rsg, rsh, rc, depth_child)
+        old_l = SplitResult(*[b[best_leaf] for b in state.best])
+        old_r = SplitResult(*[b[new_leaf] for b in state.best])
+        best_l = SplitResult(
+            *[jnp.where(do_split, nv, ov) for nv, ov in zip(best_l, old_l)]
+        )
+        best_r = SplitResult(
+            *[jnp.where(do_split, nv, ov) for nv, ov in zip(best_r, old_r)]
+        )
         best = _set_best(_set_best(state.best, best_leaf, best_l), new_leaf, best_r)
 
         return _GrowState(
             leaf_id=leaf_id,
             hists=hists,
-            sum_g=state.sum_g.at[best_leaf].set(lsg).at[new_leaf].set(rsg),
-            sum_h=state.sum_h.at[best_leaf].set(lsh).at[new_leaf].set(rsh),
-            cnt=state.cnt.at[best_leaf].set(lc).at[new_leaf].set(rc),
+            sum_g=m(m(state.sum_g, best_leaf, lsg), new_leaf, rsg),
+            sum_h=m(m(state.sum_h, best_leaf, lsh), new_leaf, rsh),
+            cnt=m(m(state.cnt, best_leaf, lc), new_leaf, rc),
             best=best,
             tree=tree,
         )
@@ -268,12 +392,7 @@ def grow_tree(
     def body(step, state):
         best_leaf = jnp.argmax(state.best.gain).astype(jnp.int32)
         do_split = state.best.gain[best_leaf] > 0.0
-        return jax.lax.cond(
-            do_split,
-            split_branch,
-            lambda args: args[0],
-            (state, jnp.int32(step), best_leaf),
-        )
+        return split_branch(state, jnp.int32(step), best_leaf, do_split)
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
     return state.tree, state.leaf_id

@@ -31,8 +31,12 @@ from .tree import (
     Tree,
     empty_tree,
     finalize_thresholds,
+    ensemble_leaves_raw,
+    ensemble_sum_binned,
+    ensemble_sum_raw,
     predict_binned,
     predict_raw,
+    stack_trees,
     predict_leaf_raw,
 )
 
@@ -68,6 +72,12 @@ class GBDT:
         self.best_iteration = -1
         self._bag_rng = np.random.RandomState(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        # reference-parity double accumulation for histograms
+        # (include/LightGBM/bin.h:21-22); see Config.hist_dtype.  f64 is
+        # enabled per-trace via the jax.enable_x64 context in
+        # train_one_iter, never by flipping the process-global flag.
+        self._use_f64_hist = config.hist_dtype == "float64"
+        self._model_version = 0
         if train_set is not None:
             self.reset_training_data(train_set, objective)
 
@@ -198,10 +208,11 @@ class GBDT:
         self._valid_bins.append(vb)
         self._valid_scores.append(jnp.asarray(vs))
         # replay existing model onto the new valid set (continued training)
-        for i, tree in enumerate(self.models):
-            k = i % K
-            self._valid_scores[-1] = self._valid_scores[-1].at[k].add(
-                predict_binned(tree, vb)
+        if self.models:
+            n_iter = len(self.models) // K
+            stacked = self._stacked_models(n_iter * K, grouped=True)
+            self._valid_scores[-1] = self._valid_scores[-1] + ensemble_sum_binned(
+                stacked, vb
             )
 
     # ---------------------------------------------------------------- bagging
@@ -265,16 +276,30 @@ class GBDT:
         could_split_any = False
         for k in range(K):
             fmask = self._sample_features()
-            tree, leaf_id = self._grow(
-                self._bins_T,
-                grad[k],
-                hess[k],
-                self._bag_mask,
-                fmask,
-                self._nbpf,
-                self._is_cat,
-                self._learner_params,
-            )
+            if self._use_f64_hist:
+                with jax.enable_x64(True):
+                    gk = grad[k].astype(jnp.float64)
+                    hk = hess[k].astype(jnp.float64)
+                    tree, leaf_id = self._grow(
+                        self._bins_T, gk, hk, self._bag_mask, fmask,
+                        self._nbpf, self._is_cat, self._learner_params,
+                    )
+                    tree = jax.tree.map(
+                        lambda a: a.astype(jnp.float32)
+                        if a.dtype == jnp.float64 else a,
+                        tree,
+                    )
+            else:
+                tree, leaf_id = self._grow(
+                    self._bins_T,
+                    grad[k],
+                    hess[k],
+                    self._bag_mask,
+                    fmask,
+                    self._nbpf,
+                    self._is_cat,
+                    self._learner_params,
+                )
             tree = tree.shrink(jnp.float32(self.learning_rate))
             if int(tree.num_leaves) > 1:
                 could_split_any = True
@@ -286,6 +311,7 @@ class GBDT:
             tree = finalize_thresholds(tree, self._bin_thresholds, self._real_feat)
             self.models.append(tree)
         self.iter_ += 1
+        self._model_version += 1
         return not could_split_any
 
     def rollback_one_iter(self) -> None:
@@ -305,6 +331,7 @@ class GBDT:
                 )
         del self.models[-K:]
         self.iter_ -= 1
+        self._model_version += 1
 
     # ------------------------------------------------------------------- eval
     def eval_at(self, data_idx: int) -> Dict[str, float]:
@@ -324,17 +351,41 @@ class GBDT:
         return np.asarray(scores)
 
     # ---------------------------------------------------------------- predict
+    def _stacked_models(self, n_trees: int, grouped: bool):
+        """Stack the first ``n_trees`` trees into one batched Tree pytree
+        (leading axis [T], or [T//K, K] when ``grouped``).  Cached per
+        (n_trees, grouped) and invalidated by the explicit model-version
+        counter (bumped by every mutation of ``self.models``)."""
+        version = getattr(self, "_model_version", 0)
+        cache = getattr(self, "_stack_cache", None)
+        if cache is None or cache[0] != version:
+            cache = (version, {})
+            self._stack_cache = cache
+        key = (n_trees, grouped)
+        if key not in cache[1]:
+            stacked = stack_trees(self.models[:n_trees])
+            if grouped:
+                K = self.num_class
+                stacked = jax.tree.map(
+                    lambda a: a.reshape((n_trees // K, K) + a.shape[1:]),
+                    stacked,
+                )
+            cache[1][key] = stacked
+        return cache[1][key]
+
     def _raw_scores(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """Whole-ensemble prediction in ONE device program (stacked-tree
+        scan, models/tree.py ensemble_sum_raw) — replaces the reference's
+        per-tree per-row traversal loop (gbdt.cpp:388-426)."""
         K = self.num_class
         n_iter = len(self.models) // K
         if num_iteration > 0:
             n_iter = min(n_iter, num_iteration)
         X = jnp.asarray(np.ascontiguousarray(X, np.float32))
-        out = np.zeros((K, X.shape[0]), np.float64)
-        for i in range(n_iter):
-            for k in range(K):
-                out[k] += np.asarray(predict_raw(self.models[i * K + k], X))
-        return out
+        if n_iter == 0:
+            return np.zeros((K, X.shape[0]), np.float64)
+        stacked = self._stacked_models(n_iter * K, grouped=True)
+        return np.asarray(ensemble_sum_raw(stacked, X), np.float64)
 
     def predict_raw_score(self, X, num_iteration: int = -1) -> np.ndarray:
         out = self._raw_scores(X, num_iteration)
@@ -357,11 +408,10 @@ class GBDT:
         if num_iteration > 0:
             n_iter = min(n_iter, num_iteration)
         X = jnp.asarray(np.ascontiguousarray(X, np.float32))
-        cols = []
-        for i in range(n_iter):
-            for k in range(K):
-                cols.append(np.asarray(predict_leaf_raw(self.models[i * K + k], X)))
-        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0), np.int32)
+        if n_iter == 0:
+            return np.zeros((X.shape[0], 0), np.int32)
+        stacked = self._stacked_models(n_iter * K, grouped=False)
+        return np.asarray(ensemble_leaves_raw(stacked, X)).T
 
     def objective_name(self) -> str:
         if self.objective is not None:
@@ -437,6 +487,7 @@ class GBDT:
         self._loaded_objective = kv.get("objective", "")
         self.feature_names = kv.get("feature_names", "").split()
         self.models = [_tree_from_lines(b) for b in tree_blocks]
+        self._model_version = getattr(self, "_model_version", 0) + 1
         self.num_init_iteration = len(self.models) // max(self.num_class, 1)
         self.iter_ = 0
 
@@ -456,22 +507,26 @@ class GBDT:
             incoming = [self._rebind_tree(t) for t in incoming]
         if prepend:
             self.models = incoming + self.models
+            self._model_version += 1
             self.num_init_iteration = len(incoming) // K
             # replay other's trees into live scores (init_score seeding,
             # application.cpp:110-115)
             if self.train_set is not None and incoming:
-                train_bins = self._bins_T.T
-                for i, tree in enumerate(incoming):
-                    k = i % K
-                    self._scores = self._scores.at[k].add(
-                        predict_binned(tree, train_bins)
+                stacked = stack_trees(incoming)
+                stacked = jax.tree.map(
+                    lambda a: a.reshape((len(incoming) // K, K) + a.shape[1:]),
+                    stacked,
+                )
+                self._scores = self._scores + ensemble_sum_binned(
+                    stacked, self._bins_T.T
+                )
+                for vi in range(len(self.valid_sets)):
+                    self._valid_scores[vi] = self._valid_scores[vi] + (
+                        ensemble_sum_binned(stacked, self._valid_bins[vi])
                     )
-                    for vi in range(len(self.valid_sets)):
-                        self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
-                            predict_binned(tree, self._valid_bins[vi])
-                        )
         else:
             self.models = self.models + incoming
+            self._model_version += 1
         self.iter_ = len(self.models) // K - self.num_init_iteration
 
     def _rebind_tree(self, tree: Tree) -> Tree:

@@ -60,9 +60,13 @@ def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int):
         def local(a):
             return jax.lax.dynamic_slice_in_dim(a, start, Fs, axis=0)
 
-        def hist_fn(_bins_T_full, g, h, m):
-            # local-shard histogram: the per-device share of the search work
-            return histogram_feature_major(local(bins_p), g, h, m, num_bins=num_bins)
+        def hist_fn(bins_arg, g, h, m):
+            # local-shard histogram: the per-device share of the search
+            # work.  Pad + slice the PASSED matrix (not the closed-over
+            # full one): grow_tree may hand us a gathered smaller-child
+            # row buffer whose row count differs from n.
+            bp = jnp.pad(bins_arg, ((0, pad), (0, 0)))
+            return histogram_feature_major(local(bp), g, h, m, num_bins=num_bins)
 
         def search_fn(hist, sg, sh, c, can, _fm, _nb, _ic, prm):
             r = find_best_split(

@@ -158,3 +158,50 @@ def test_depthwise_data_parallel_matches_single_device():
     # row partition agrees wherever the trees agree structurally
     same = np.asarray(leaf1) == np.asarray(leaf2)
     assert same.mean() > 0.99
+
+
+def test_dp_exact_with_float64_histograms():
+    """With hist_dtype=float64 (the reference's double accumulation,
+    include/LightGBM/bin.h:21-22) parallel trees must be EXACTLY the
+    serial trees — zero divergent nodes, identical leaf partition."""
+    jax.config.update("jax_enable_x64", True)
+    try:
+        F, B, L = 12, 32, 31
+        for seed in (3, 7, 11):
+            args = list(_random_problem(1024, F, B, seed=seed))
+            args[1] = args[1].astype(jnp.float64)  # grad
+            args[2] = args[2].astype(jnp.float64)  # hess
+            params = _params()
+            t_s, leaf_s = grow_tree(*args, params, num_bins=B, max_leaves=L)
+            grow_dp = make_data_parallel_grower(data_mesh(), num_bins=B, max_leaves=L)
+            t_d, leaf_d = grow_dp(*args, params)
+            _assert_trees_match(t_s, t_d, max_divergent=0)
+            np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_gbdt_hist_dtype_float64_end_to_end():
+    """Config.hist_dtype=float64 trains end to end and reaches the same
+    accuracy as float32."""
+    from lightgbm_tpu.io import BinnedDataset, Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(2)
+    n, F = 600, 6
+    X = rng.randn(n, F)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    try:
+        cfg = Config(
+            objective="binary", num_leaves=15, min_data_in_leaf=20,
+            hist_dtype="float64", metric=["binary_logloss"],
+        )
+        ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+        obj = create_objective(cfg, ds.metadata, ds.num_data)
+        booster = GBDT(cfg, ds, obj)
+        for _ in range(20):
+            booster.train_one_iter()
+        assert booster.eval_at(0)["binary_logloss"] < 0.4
+    finally:
+        jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,117 @@
+"""Stacked-ensemble prediction: one device program must reproduce the
+per-tree traversal loop exactly (GBDT::GetPredictAt semantics,
+reference gbdt.cpp:388-426; per-row walk tree.h:226-238)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.tree import predict_leaf_raw, predict_raw
+
+
+def _make_problem(n=1200, f=12, n_class=1, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    if n_class == 1:
+        y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.3 * rng.randn(n) > 0.3).astype(
+            np.float64
+        )
+    else:
+        y = (np.abs(X[:, 0]) * 2 + X[:, 1] > 0).astype(np.float64) + (
+            X[:, 2] > 0.5
+        ).astype(np.float64)
+    return X, y
+
+
+@pytest.mark.parametrize("objective,n_class", [("binary", 1), ("multiclass", 3)])
+def test_stacked_matches_per_tree_loop(objective, n_class):
+    X, y = _make_problem(n_class=n_class)
+    params = {"objective": objective, "num_leaves": 15, "learning_rate": 0.2,
+              "min_data_in_leaf": 20, "verbose": 0}
+    if n_class > 1:
+        params["num_class"] = n_class
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=12)
+    gbdt = bst._gbdt
+    K = gbdt.num_class
+    assert len(gbdt.models) == 12 * K
+
+    Xq = X[:200]
+    # the old per-tree loop, reproduced inline (f32 accumulation in the
+    # same tree order as the scan)
+    import jax.numpy as jnp
+
+    Xj = jnp.asarray(Xq)
+    want = np.zeros((K, Xq.shape[0]), np.float32)
+    for i in range(12):
+        for k in range(K):
+            want[k] += np.asarray(predict_raw(gbdt.models[i * K + k], Xj))
+    got = gbdt._raw_scores(Xq)
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+    # leaf indices: column j = tree j in model order
+    want_leaves = np.stack(
+        [np.asarray(predict_leaf_raw(t, Xj)) for t in gbdt.models], axis=1
+    )
+    got_leaves = gbdt.predict_leaf_index(Xq)
+    np.testing.assert_array_equal(got_leaves, want_leaves)
+
+    # num_iteration truncation
+    got5 = gbdt._raw_scores(Xq, num_iteration=5)
+    want5 = np.zeros((K, Xq.shape[0]), np.float32)
+    for i in range(5):
+        for k in range(K):
+            want5[k] += np.asarray(predict_raw(gbdt.models[i * K + k], Xj))
+    np.testing.assert_allclose(got5, want5, rtol=2e-6, atol=2e-6)
+
+
+def test_stack_cache_invalidation():
+    X, y = _make_problem()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": 0},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    gbdt = bst._gbdt
+    p3 = gbdt.predict(X[:50])
+    # growing the model must invalidate the stack cache
+    gbdt.train_one_iter()
+    p4 = gbdt.predict(X[:50])
+    assert not np.allclose(p3, p4)
+
+
+def test_stacked_mixed_leaf_budgets():
+    """Trees padded to a common budget stack and predict correctly
+    (merge_from of models with different num_leaves)."""
+    X, y = _make_problem()
+    Xq = X[:100]
+    b1 = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": 0},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    b2 = lgb.train({"objective": "binary", "num_leaves": 31, "verbose": 0},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    r1 = b1._gbdt.predict_raw_score(Xq)
+    r2 = b2._gbdt.predict_raw_score(Xq)
+    g = b2._gbdt
+    g.merge_from(b1._gbdt)  # append: 3 big trees then 3 small trees
+    raw_merged = g.predict_raw_score(Xq)
+    np.testing.assert_allclose(raw_merged, r1 + r2, rtol=2e-6, atol=2e-6)
+
+
+def test_rollback_invalidates_stack_cache():
+    """Predictions after rollback + retrain must come from the NEW trees,
+    not a stale stacked cache (model-version invalidation)."""
+    X, y = _make_problem()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": 0,
+                     "learning_rate": 0.3},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    g = bst._gbdt
+    _ = g.predict(X[:50])           # populate the cache at 4 trees
+    g.rollback_one_iter()
+    p3 = g.predict(X[:50])          # 3 trees
+    g.train_one_iter()              # back to 4 trees, DIFFERENT last tree
+    p4 = g.predict(X[:50])
+    # recompute 4-tree prediction from scratch (no cache) as truth
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.tree import predict_raw
+    raw = np.zeros(50, np.float32)
+    for t in g.models:
+        raw += np.asarray(predict_raw(t, jnp.asarray(X[:50])))
+    want = 1.0 / (1.0 + np.exp(-2.0 * g.sigmoid * raw))
+    np.testing.assert_allclose(p4, want, rtol=2e-5, atol=2e-6)
+    assert not np.allclose(p3, p4)
