@@ -101,6 +101,13 @@ def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],
 
 def run_train(cfg: Config) -> GBDT:
     """InitTrain + Train (application.cpp:187-239)."""
+    if cfg.is_parallel and cfg.num_machines > 1:
+        # Network::Init analog (application.cpp:190): attach this process
+        # to the multi-host JAX runtime before any data loads, so the
+        # per-rank ingest partition and mapper allgather see the world
+        from .parallel.multihost import initialize_from_config
+
+        initialize_from_config(cfg)
     t0 = time.perf_counter()
     train = BinnedDataset.from_file(cfg.data, cfg)
     Log.info(

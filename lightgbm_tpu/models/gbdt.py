@@ -127,6 +127,32 @@ class GBDT:
         SPMD over the local device mesh — the reference's `num_machines`
         world (network.cpp:20-38) is the mesh's row axis."""
         tl = self.config.tree_learner
+        if jax.process_count() > 1:
+            # true multi-host world (Network::Init analog already ran,
+            # parallel/multihost.py): rows are the per-process ingest
+            # partition, collectives cross hosts over the global mesh.
+            # This check precedes the serial branch — a "serial" learner
+            # on per-process partitions would silently train on a
+            # fraction of the data.
+            from ..log import Log
+            from ..parallel import data_mesh
+            from ..parallel.multihost import make_multihost_data_parallel_grower
+
+            if tl != "data":
+                Log.warning(
+                    f"tree_learner={tl} runs data-parallel across "
+                    "processes (feature/voting sharding stays intra-host)"
+                )
+            return make_multihost_data_parallel_grower(
+                data_mesh(),  # all global devices
+                num_bins=self._num_bins,
+                max_leaves=self.max_leaves,
+                growth=self.config.tree_growth,
+                sorted_hist=(
+                    self.config.tree_growth == "depthwise"
+                    and self._use_matmul_hist()
+                ),
+            )
         if tl == "serial" or len(jax.devices()) == 1:
             if self.config.tree_growth == "depthwise":
                 from ..learners.depthwise import grow_tree_depthwise

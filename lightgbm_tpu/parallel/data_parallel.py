@@ -39,19 +39,14 @@ from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from .mesh import ROW_AXIS, row_padded_grower
 
 
-def make_data_parallel_grower(
+def data_parallel_sharded(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
     growth: str = "leafwise", sorted_hist: bool = False,
 ):
-    """Build a grow(bins_T, grad, hess, bag_mask, feature_mask,
-    num_bins_per_feature, is_categorical, params) -> (tree, leaf_id)
-    callable running the serial growth algorithm SPMD over ``mesh``.
-
-    ``growth="depthwise"`` runs the level-synchronous learner instead:
-    the per-level fused histogram is psum'd once per LEVEL (one collective
-    per level instead of one per split — even less comm than the
-    reference's per-level reduce-scatter)."""
-    num_shards = mesh.shape[axis]
+    """The raw shard-mapped grow fn over ``mesh`` (rows sharded on
+    ``axis``).  Callers are responsible for row padding / global-array
+    plumbing: use :func:`make_data_parallel_grower` single-host and
+    multihost.make_multihost_data_parallel_grower across processes."""
     hist_local = functools.partial(histogram_feature_major, num_bins=num_bins)
 
     def hist_psum(bins_T, grad, hess, mask):
@@ -99,11 +94,29 @@ def make_data_parallel_grower(
             reduce_fn=reduce_sum,
         )
 
-    sharded = jax.shard_map(
+    return jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(None, axis), P(axis), P(axis), P(axis), P(), P(), P(), P()),
         out_specs=(P(), P(axis)),
         check_vma=False,
     )
-    return row_padded_grower(sharded, num_shards)
+
+
+def make_data_parallel_grower(
+    mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
+    growth: str = "leafwise", sorted_hist: bool = False,
+):
+    """Build a grow(bins_T, grad, hess, bag_mask, feature_mask,
+    num_bins_per_feature, is_categorical, params) -> (tree, leaf_id)
+    callable running the serial growth algorithm SPMD over ``mesh``.
+
+    ``growth="depthwise"`` runs the level-synchronous learner instead:
+    the per-level fused histogram is psum'd once per LEVEL (one collective
+    per level instead of one per split — even less comm than the
+    reference's per-level reduce-scatter)."""
+    sharded = data_parallel_sharded(
+        mesh, num_bins, max_leaves, axis=axis, growth=growth,
+        sorted_hist=sorted_hist,
+    )
+    return row_padded_grower(sharded, mesh.shape[axis])

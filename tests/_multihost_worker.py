@@ -1,0 +1,133 @@
+"""Worker for the 2-process multi-host test (launched by
+tests/test_multihost.py).  Each process holds HALF the rows; the
+multihost data-parallel grower must reproduce the single-process serial
+tree exactly (the reference's parallel==serial invariant across
+machines, split_info.hpp:98-103)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    coord = os.environ["LGBM_TPU_COORDINATOR"]
+    pid = int(os.environ["LGBM_TPU_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8, f"expected 8 global devices, got {len(jax.devices())}"
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.learners.serial import TreeLearnerParams, grow_tree
+    from lightgbm_tpu.parallel import data_mesh
+    from lightgbm_tpu.parallel.multihost import (
+        initialize_from_config,
+        make_multihost_data_parallel_grower,
+    )
+
+    assert initialize_from_config(None)  # idempotent once attached
+
+    # deterministic shared problem; each process keeps a contiguous half
+    n, F, B, L = 2048, 10, 32, 31
+    rng = np.random.RandomState(5)
+    bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = (np.abs(rng.randn(n)) + 0.1).astype(np.float32)
+    half = n // 2
+    lo, hi = pid * half, (pid + 1) * half
+
+    cfg = Config(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+    params = TreeLearnerParams.from_config(cfg)
+    fmask = np.ones(F, bool)
+    nbpf = np.full(F, B, np.int32)
+    is_cat = np.zeros(F, bool)
+
+    mesh = data_mesh()
+    grow = make_multihost_data_parallel_grower(
+        mesh, num_bins=B, max_leaves=L
+    )
+    tree_mh, leaf_local = grow(
+        bins[:, lo:hi], grad[lo:hi], hess[lo:hi], np.ones(half, np.float32),
+        fmask, nbpf, is_cat, params,
+    )
+    assert leaf_local.shape == (half,)
+
+    # single-process truth on the FULL data (local jit on this process's
+    # devices only — no collectives)
+    import jax.numpy as jnp
+
+    tree_s, leaf_s = grow_tree(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, jnp.float32), jnp.asarray(fmask), jnp.asarray(nbpf),
+        jnp.asarray(is_cat), params, num_bins=B, max_leaves=L,
+    )
+
+    nl = int(tree_s.num_leaves)
+    assert int(tree_mh.num_leaves) == nl, (
+        f"num_leaves {int(tree_mh.num_leaves)} != {nl}"
+    )
+    assert nl > 4, "trivial tree"
+    diverged = 0
+    for f in ("split_feature", "threshold_bin", "decision_type"):
+        a = np.asarray(getattr(tree_s, f))[: nl - 1]
+        b = np.asarray(getattr(tree_mh, f))[: nl - 1]
+        diverged = max(diverged, int((a != b).sum()))
+    assert diverged <= 1, f"{diverged} divergent splits"
+    if diverged == 0:
+        np.testing.assert_array_equal(
+            np.asarray(leaf_s)[lo:hi], leaf_local,
+            err_msg="local leaf partition mismatch",
+        )
+    print(f"MULTIHOST_OK pid={pid} num_leaves={nl} diverged={diverged}",
+          flush=True)
+
+    # ---- end-to-end boosting through GBDT's multihost routing: each
+    # process ingests its half with SHARED bin mappers (the rank-
+    # consistent mapper contract, io/distributed.py), trains 5 rounds,
+    # and both processes must end with byte-identical models
+    import hashlib
+
+    from lightgbm_tpu.io.binner import find_bin_mappers
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng2 = np.random.RandomState(9)
+    Xf = rng2.randn(n, 6).astype(np.float64)
+    yf = (Xf[:, 0] + 0.5 * Xf[:, 1] * Xf[:, 2] > 0).astype(np.float32)
+    cfg2 = Config(
+        objective="binary", num_leaves=15, min_data_in_leaf=20,
+        tree_learner="data", num_machines=2, metric=["binary_logloss"],
+    )
+    mappers = find_bin_mappers(Xf, max_bin=cfg2.max_bin)  # full-data: identical
+    ds = BinnedDataset.from_matrix(
+        Xf[lo:hi], Metadata(label=yf[lo:hi]), config=cfg2, mappers_all=mappers
+    )
+    obj = create_objective(cfg2, ds.metadata, ds.num_data)
+    booster = GBDT(cfg2, ds, obj)
+    for _ in range(5):
+        booster.train_one_iter()
+    model_txt = booster.save_model_to_string()
+    digest = hashlib.sha256(model_txt.encode()).hexdigest()[:16]
+    ll = booster.eval_at(0)["binary_logloss"]
+    assert ll < 0.5, f"local logloss {ll}"
+    print(f"MODEL_HASH={digest}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
