@@ -113,6 +113,25 @@ class Dataset:
             if meta.query_boundaries is not None:
                 self._inner.metadata.query_boundaries = meta.query_boundaries
                 self._inner.metadata._finish()
+        elif hasattr(self.data, "tocsr"):  # scipy sparse: O(nnz) ingest,
+            # never densified to f64 (reference SparseBin path,
+            # sparse_bin.hpp; round 1 called .toarray() here)
+            if meta.label is None:
+                raise LightGBMError("label should not be None for training data")
+            csr = self.data.tocsr()
+            indptr = np.asarray(csr.indptr, dtype=np.int64)
+            indices = np.asarray(csr.indices, dtype=np.int64)
+            values = np.asarray(csr.data, dtype=np.float64)
+            if ref_inner is not None:
+                self._inner = ref_inner.align_with_csr(
+                    indptr, indices, values, meta
+                )
+            else:
+                self._inner = BinnedDataset.from_csr(
+                    indptr, indices, values, csr.shape[1], meta, config=cfg,
+                    categorical_features=self.categorical_feature,
+                    feature_names=self.feature_name,
+                )
         else:
             X = _densify(self.data)
             if meta.label is None:

@@ -231,6 +231,14 @@ def run_predict(cfg: Config) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     """main.cpp:4-22."""
+    # honor JAX_PLATFORMS before the first jax op: the axon TPU plugin
+    # ignores the bare env var and dials the TPU tunnel anyway, so a
+    # CPU-pinned CLI run (tests, CI) must pin via jax.config
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and "axon" not in plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     argv = sys.argv[1:] if argv is None else list(argv)
     try:
         params = load_parameters(argv)

@@ -110,15 +110,19 @@ class BinnedDataset:
 
     def __init__(
         self,
-        X_bin: np.ndarray,
+        X_bin,
         bin_mappers: List[BinMapper],
         used_feature_map: np.ndarray,
         num_total_features: int,
         metadata: Metadata,
         feature_names: Optional[List[str]] = None,
     ):
-        assert X_bin.ndim == 2 and X_bin.shape[1] == len(bin_mappers)
-        self.X_bin = X_bin  # [n, F_used] uint8/uint16
+        assert len(X_bin.shape) == 2 and X_bin.shape[1] == len(bin_mappers)
+        # [n, F_used] uint8/uint16 ndarray, or a SparseBins CSR structure
+        # (io/sparse.py) for high-sparsity data — the SparseBin analog
+        # (src/io/sparse_bin.hpp), kept when density < 0.2 mirroring the
+        # reference's sparse_rate >= 0.8 threshold (bin.cpp:291-302)
+        self.X_bin = X_bin
         self.bin_mappers = bin_mappers  # per *used* feature
         # used_feature_map[orig_col] = inner feature idx or -1 (dataset.h:286)
         self.used_feature_map = used_feature_map
@@ -129,6 +133,17 @@ class BinnedDataset:
         ]
 
     # ---------------------------------------------------------------- props
+    @property
+    def is_sparse(self) -> bool:
+        return not isinstance(self.X_bin, np.ndarray)
+
+    def dense_bins(self) -> np.ndarray:
+        """The dense [n, F_used] binned matrix — materialized on demand
+        for sparse storage (binned u8 is 8-64x smaller than the raw f64
+        the round-1 path densified, and trivial columns are already
+        dropped, so this is the TPU-transfer layout, not a memory bomb)."""
+        return self.X_bin.toarray() if self.is_sparse else self.X_bin
+
     @property
     def num_data(self) -> int:
         return self.X_bin.shape[0]
@@ -212,6 +227,61 @@ class BinnedDataset:
             X_bin, used_mappers, used_map, f_total, metadata, feature_names
         )
 
+    @staticmethod
+    def from_csr(
+        indptr: np.ndarray,
+        indices: np.ndarray,
+        values: np.ndarray,
+        num_cols: int,
+        metadata: Metadata,
+        config: Optional[Config] = None,
+        categorical_features: Sequence[int] = (),
+        feature_names: Optional[List[str]] = None,
+        mappers_all: Optional[List[BinMapper]] = None,
+        keep_sparse: Optional[bool] = None,
+    ) -> "BinnedDataset":
+        """Bin a CSR matrix in O(nnz) memory — no dense f64 ever exists.
+
+        Mirrors the reference's sparse push path (Feature::PushData on
+        ``(col, value)`` pairs, feature.h:79-85 + sparse_bin.hpp): bin
+        mappers are found from a sampled row subset with elided zeros
+        counted (bin.cpp:48-85), then every stored entry is bin-encoded
+        in place.  Storage stays CSR when density < 0.2 (``keep_sparse``
+        overrides), else the dense u8 matrix is built.
+        """
+        from .sparse import encode_csr_bins, find_bin_mappers_csr
+
+        config = config or Config()
+        n = len(indptr) - 1
+        if mappers_all is None:
+            cnt = min(n, int(config.bin_construct_sample_cnt))
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_idx = (
+                np.arange(n)
+                if cnt >= n
+                else np.sort(rng.choice(n, size=cnt, replace=False))
+            )
+            mappers_all = find_bin_mappers_csr(
+                indptr, indices, values, num_cols, sample_idx,
+                max_bin=config.max_bin,
+                categorical_features=categorical_features,
+            )
+        used_map = np.full(num_cols, -1, dtype=np.int64)
+        used_mappers: List[BinMapper] = []
+        for j, m in enumerate(mappers_all):
+            if not m.is_trivial:
+                used_map[j] = len(used_mappers)
+                used_mappers.append(m)
+        sb = encode_csr_bins(indptr, indices, values, used_map, used_mappers)
+        f_used = max(len(used_mappers), 1)
+        density = sb.nnz / float(max(n, 1) * f_used)
+        if keep_sparse is None:
+            keep_sparse = density < 0.2
+        X_bin = sb if keep_sparse else sb.toarray()
+        return BinnedDataset(
+            X_bin, used_mappers, used_map, num_cols, metadata, feature_names
+        )
+
     def align_with(
         self, X: np.ndarray, metadata: Metadata
     ) -> "BinnedDataset":
@@ -226,6 +296,40 @@ class BinnedDataset:
         _encode_bins(X, self.used_feature_map, self.bin_mappers, X_bin)
         return BinnedDataset(
             X_bin,
+            self.bin_mappers,
+            self.used_feature_map,
+            self.num_total_features,
+            metadata,
+            self.feature_names,
+        )
+
+    def align_with_csr(
+        self,
+        indptr: np.ndarray,
+        indices: np.ndarray,
+        values: np.ndarray,
+        metadata: Metadata,
+        keep_sparse: Optional[bool] = None,
+    ) -> "BinnedDataset":
+        """Sparse counterpart of ``align_with``: bin CSR rows with THIS
+        dataset's mappers in O(nnz)."""
+        from .sparse import encode_csr_bins
+
+        # entries in columns this dataset never saw map to no used feature
+        in_range = indices < len(self.used_feature_map)
+        if not in_range.all():
+            n = len(indptr) - 1
+            rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+            rows, indices, values = rows[in_range], indices[in_range], values[in_range]
+            row_lens = np.bincount(rows, minlength=n)
+            indptr = np.concatenate([[0], np.cumsum(row_lens, dtype=np.int64)])
+        sb = encode_csr_bins(
+            indptr, indices, values, self.used_feature_map, self.bin_mappers
+        )
+        if keep_sparse is None:
+            keep_sparse = self.is_sparse
+        return BinnedDataset(
+            sb if keep_sparse else sb.toarray(),
             self.bin_mappers,
             self.used_feature_map,
             self.num_total_features,
@@ -254,7 +358,15 @@ class BinnedDataset:
                 return BinnedDataset.load_binary(bin_path)
             except Exception:
                 pass
-        raw, names = parse_file(path, has_header=config.has_header)
+        from .parser import _read_head, detect_format
+
+        head = _read_head(path, 3 if config.has_header else 2)
+        fmt = detect_format(head[1:] if config.has_header else head)
+        if fmt == "libsvm" and not config.weight_column and not config.group_column:
+            return BinnedDataset._from_libsvm_sparse(
+                path, config, reference=reference, rank=rank
+            )
+        raw, names = parse_file(path, has_header=config.has_header, fmt=fmt)
         side = Metadata.load_side_files(path)
 
         # ---- resolve column roles on the FULL file (dataset_loader.cpp:23-160)
@@ -353,15 +465,118 @@ class BinnedDataset:
             ds.save_binary(bin_path)
         return ds
 
+    @staticmethod
+    def _from_libsvm_sparse(
+        path: str,
+        config: Config,
+        reference: Optional["BinnedDataset"] = None,
+        rank: Optional[int] = None,
+    ) -> "BinnedDataset":
+        """LibSVM ingest in O(nnz) memory — streamed CSR parse, sparse
+        bin finding with elided zeros, in-place bin encoding.  Replaces
+        the round-1 dense-f64 materialization (a news20-scale memory
+        bomb; reference handles this via SparseBin, sparse_bin.hpp).
+
+        Column-space note: in the dense parse the label occupies column 0
+        and token index ``t`` lands at raw column ``t+1``; sparse keeps
+        token indices as feature indices, so raw-space ``ignore_column``/
+        ``categorical_column`` entries shift down by one.
+        """
+        from .sparse import _ranges_concat, parse_libsvm_csr
+
+        label, indptr, indices, values, num_cols = parse_libsvm_csr(
+            path, has_header=config.has_header
+        )
+        side = Metadata.load_side_files(path)
+        n = len(label)
+
+        ignore = {
+            j - 1
+            for j in _resolve_column_list(config.ignore_column, None)
+            if j >= 1
+        }
+        if ignore:
+            keep = ~np.isin(indices, np.asarray(sorted(ignore)))
+            rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+            rows, indices, values = rows[keep], indices[keep], values[keep]
+            row_lens = np.bincount(rows, minlength=n)
+            indptr = np.concatenate([[0], np.cumsum(row_lens, dtype=np.int64)])
+        cats = [
+            j - 1
+            for j in _resolve_column_list(config.categorical_column, None)
+            if j >= 1
+        ]
+        meta = Metadata(
+            label=label,
+            weights=side.get("weights"),
+            query_boundaries=side.get("query_boundaries"),
+            init_score=side.get("init_score"),
+        )
+
+        distributed = config.num_machines > 1 and not config.is_pre_partition
+        mappers_all = None
+        if distributed:
+            from .distributed import partition_rows
+            from .sparse import find_bin_mappers_csr
+            import jax
+
+            if rank is None:
+                rank = jax.process_index()
+            keep_rows = partition_rows(
+                n, rank, config.num_machines,
+                seed=config.data_random_seed,
+                query_boundaries=meta.query_boundaries,
+            )
+            # shared-seed sample over the FULL file gives every rank
+            # identical mappers with zero communication (every rank
+            # parsed the whole file when is_pre_partition=false)
+            cnt = min(n, int(config.bin_construct_sample_cnt))
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_idx = (
+                np.arange(n) if cnt >= n
+                else np.sort(rng.choice(n, size=cnt, replace=False))
+            )
+            mappers_all = find_bin_mappers_csr(
+                indptr, indices, values, num_cols, sample_idx,
+                max_bin=config.max_bin, categorical_features=cats,
+            )
+            keep_rows = np.asarray(keep_rows)
+            starts = indptr[keep_rows]
+            lens = indptr[keep_rows + 1] - starts
+            take = _ranges_concat(starts, lens)
+            indices, values = indices[take], values[take]
+            indptr = np.concatenate([[0], np.cumsum(lens, dtype=np.int64)])
+            meta = meta.subset(keep_rows)
+
+        if reference is not None:
+            return reference.align_with_csr(indptr, indices, values, meta)
+        ds = BinnedDataset.from_csr(
+            indptr, indices, values, num_cols, meta, config,
+            categorical_features=cats, mappers_all=mappers_all,
+        )
+        if config.is_save_binary_file and not distributed:
+            ds.save_binary(path + ".bin")
+        return ds
+
     # ---------------------------------------------------------- binary cache
     def save_binary(self, path: str) -> None:
         import json
 
         tmp = path + ".tmp.npz"
+        sparse_fields = {}
+        if self.is_sparse:
+            sparse_fields = dict(
+                sp_indptr=self.X_bin.indptr,
+                sp_col=self.X_bin.col,
+                sp_bin=self.X_bin.bin,
+                sp_default=self.X_bin.default_bins,
+                sp_shape=np.asarray(self.X_bin.shape, dtype=np.int64),
+            )
         np.savez_compressed(
             tmp,
             magic=BINARY_MAGIC,
-            X_bin=self.X_bin,
+            X_bin=np.empty((0, 0), np.uint8) if self.is_sparse else self.X_bin,
+            **sparse_fields,
             used_feature_map=self.used_feature_map,
             num_total_features=self.num_total_features,
             mappers=json.dumps([m.to_dict() for m in self.bin_mappers]),
@@ -397,8 +612,17 @@ class BinnedDataset:
                 else None,
                 init_score=z["init_score"] if z["init_score"].size else None,
             )
+            if "sp_indptr" in z:
+                from .sparse import SparseBins
+
+                storage = SparseBins(
+                    z["sp_indptr"], z["sp_col"], z["sp_bin"],
+                    z["sp_default"], tuple(z["sp_shape"]),
+                )
+            else:
+                storage = z["X_bin"]
             return BinnedDataset(
-                z["X_bin"],
+                storage,
                 mappers,
                 z["used_feature_map"],
                 int(z["num_total_features"]),
@@ -411,7 +635,7 @@ class BinnedDataset:
         """Row subset sharing bin mappers (Dataset::Subset, dataset.cpp:59)."""
         indices = np.asarray(indices)
         return BinnedDataset(
-            self.X_bin[indices],
+            self.X_bin.rows(indices) if self.is_sparse else self.X_bin[indices],
             self.bin_mappers,
             self.used_feature_map,
             self.num_total_features,

@@ -95,7 +95,7 @@ class GBDT:
         if self.objective is not None and self.objective.name == "binary":
             self.sigmoid = self.objective.sigmoid
 
-        self._bins_T = jnp.asarray(np.ascontiguousarray(train_set.X_bin.T))
+        self._bins_T = jnp.asarray(np.ascontiguousarray(train_set.dense_bins().T))
         self._num_bins = max(int(train_set.max_num_bin), 2)
         self._nbpf = jnp.asarray(train_set.num_bins_per_feature)
         self._is_cat = jnp.asarray(train_set.is_categorical)
@@ -223,7 +223,7 @@ class GBDT:
             create_metrics(self.config, valid_set.metadata, valid_set.num_data)
         )
         K = self.num_class
-        vb = jnp.asarray(valid_set.X_bin)
+        vb = jnp.asarray(valid_set.dense_bins())
         init = valid_set.metadata.init_score
         if init is not None:
             vs = np.asarray(init, np.float32).reshape(K, valid_set.num_data)

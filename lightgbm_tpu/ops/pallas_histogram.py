@@ -30,6 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_CHUNK = 1024
+FGROUP = 8  # feature rows per kernel loop step (int8 sublane-pack aligned)
 
 
 def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, chunk):
@@ -52,17 +53,27 @@ def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, c
     stats = stats_ref[...]  # [C, 4]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (chunk, num_b), 1)
 
-    def body(f, _):
-        row = bins_ref[pl.ds(f, 1), :].astype(jnp.int32)  # [1, C]
-        onehot = (row.reshape(chunk, 1) == iota_b).astype(jnp.float32)  # [C, B]
-        contrib = jax.lax.dot_general(
-            onehot, stats, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [B, 4]
-        out_ref[0, pl.ds(f, 1)] = out_ref[0, pl.ds(f, 1)] + contrib[None]
+    # int8 VMEM rows are 4-packed per sublane, so a dynamically-indexed
+    # SINGLE-row vector.load cannot be proven aligned by Mosaic ("index
+    # in dimension 0 is a multiple of 4").  Instead the loop walks the
+    # feature axis in groups of FGROUP rows — the dynamic start g*FGROUP
+    # is provably aligned — and slices rows statically within the group,
+    # keeping compiled code size O(FGROUP), not O(num_f).
+    num_groups = num_f // FGROUP  # caller pads F to a FGROUP multiple
+
+    def group_body(g, _):
+        blk = bins_ref[pl.ds(g * FGROUP, FGROUP), :].astype(jnp.int32)
+        for i in range(FGROUP):
+            row = blk[i, :].reshape(chunk, 1)
+            onehot = (row == iota_b).astype(jnp.float32)  # [C, B]
+            contrib = jax.lax.dot_general(
+                onehot, stats, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [B, 4]
+            out_ref[0, g * FGROUP + i] = out_ref[0, g * FGROUP + i] + contrib
         return 0
 
-    jax.lax.fori_loop(0, num_f, body, 0)
+    jax.lax.fori_loop(0, num_groups, group_body, 0)
 
 
 def _pad_pow(b: int) -> int:
@@ -94,6 +105,9 @@ def histogram_by_leaf_sorted(
     L = num_leaves
     C = chunk
     B = _pad_pow(num_bins)
+    Fp = ((F + FGROUP - 1) // FGROUP) * FGROUP  # kernel walks FGROUP rows/step
+    if Fp != F:
+        bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
 
     # ---- leaf-sorted order + per-leaf chunk-padded layout
     leaf_id = leaf_id.astype(jnp.int32)
@@ -115,14 +129,18 @@ def histogram_by_leaf_sorted(
     rank = jnp.arange(n) - row_start[leaf_sorted]  # position within leaf
     dest = (chunk_start[leaf_sorted] * C + rank).astype(jnp.int32)  # [n]
 
-    bins_buf = jnp.zeros((F, n_pad), bins_T.dtype).at[:, dest].set(
-        bins_T[:, order]
+    # invert dest into a gather map: a [n_pad] 1-D scatter of int32, then
+    # row GATHERS for the big buffers — far cheaper on TPU than scattering
+    # the whole [Fp, n_pad] matrix (pad slots read OOB -> fill 0)
+    src = jnp.full((n_pad,), n, jnp.int32).at[dest].set(
+        order.astype(jnp.int32)
     )
+    bins_buf = jnp.take(bins_T, src, axis=1, mode="fill", fill_value=0)
     gm = grad * mask
     hm = hess * mask
     stats = jnp.stack([gm, hm, mask, jnp.zeros_like(mask)], axis=-1)  # [n, 4]
-    stats_buf = jnp.zeros((n_pad, 4), jnp.float32).at[dest].set(
-        stats[order].astype(jnp.float32)
+    stats_buf = jnp.take(
+        stats.astype(jnp.float32), src, axis=0, mode="fill", fill_value=0.0
     )
 
     # chunk -> leaf map; trailing unused chunks land on the dummy row L
@@ -132,26 +150,26 @@ def histogram_by_leaf_sorted(
     ).astype(jnp.int32)
     leaf_of_chunk = jnp.where(cidx < chunk_start[L], leaf_of_chunk, L)
 
-    kernel = functools.partial(_hist_kernel, num_f=F, num_b=B, chunk=C)
+    kernel = functools.partial(_hist_kernel, num_f=Fp, num_b=B, chunk=C)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_chunks,),
         in_specs=[
-            pl.BlockSpec((F, C), lambda c, leaf_ref: (0, c)),
+            pl.BlockSpec((Fp, C), lambda c, leaf_ref: (0, c)),
             pl.BlockSpec((C, 4), lambda c, leaf_ref: (c, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, F, B, 4), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
+            (1, Fp, B, 4), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
         ),
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((L + 1, F, B, 4), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((L + 1, Fp, B, 4), jnp.float32),
         interpret=interpret,
     )(leaf_of_chunk, bins_buf, stats_buf)
 
-    return out[:L, :, :num_bins, :3]
+    return out[:L, :F, :num_bins, :3]
 
 
 @functools.lru_cache(maxsize=None)
