@@ -83,6 +83,37 @@ def _encode_bins(
         X_bin[:, inner] = mappers[inner].value_to_bin(X[:, orig])
 
 
+def _sample_row_indices(n: int, config: Config) -> np.ndarray:
+    """The shared-seed bin-construction sample draw (config.h:108 default
+    50k rows).  ONE implementation on purpose: streaming, distributed,
+    sparse, and in-memory loading must all draw the identical rows for
+    their bin mappers (and therefore trees) to be bit-identical."""
+    cnt = min(n, int(config.bin_construct_sample_cnt))
+    rng = np.random.RandomState(config.data_random_seed)
+    if cnt >= n:
+        return np.arange(n)
+    return np.sort(rng.choice(n, size=cnt, replace=False))
+
+
+def _resolve_roles(config: Config, names: Optional[List[str]]):
+    """Column-role resolution shared by the one-shot and streaming
+    loaders (dataset_loader.cpp:23-160): returns (label_col, ignore set,
+    categorical cols, weight_col, group_col) in raw column space, with
+    weight/group added to the ignore set."""
+    label_col = _resolve_column(config.label_column, names)
+    if label_col is None:
+        label_col = 0
+    ignore = set(_resolve_column_list(config.ignore_column, names))
+    cats = _resolve_column_list(config.categorical_column, names)
+    weight_col = _resolve_column(config.weight_column, names)
+    group_col = _resolve_column(config.group_column, names)
+    if weight_col is not None:
+        ignore.add(weight_col)
+    if group_col is not None:
+        ignore.add(group_col)
+    return label_col, ignore, cats, weight_col, group_col
+
+
 def _resolve_column(spec: str, names: Optional[List[str]]) -> Optional[int]:
     """Resolve 'name:foo' or integer-string column spec to an index
     (dataset_loader.cpp:23-160)."""
@@ -195,14 +226,7 @@ class BinnedDataset:
         X = np.ascontiguousarray(X, dtype=np.float64)
         n, f_total = X.shape
         if mappers_all is None:
-            # sample rows for bin finding (config.h:108 default 50k)
-            cnt = min(n, int(config.bin_construct_sample_cnt))
-            rng = np.random.RandomState(config.data_random_seed)
-            sample_idx = (
-                np.arange(n)
-                if cnt >= n
-                else np.sort(rng.choice(n, size=cnt, replace=False))
-            )
+            sample_idx = _sample_row_indices(n, config)
             mappers_all = find_bin_mappers(
                 X[sample_idx],
                 total_sample_cnt=len(sample_idx),
@@ -254,13 +278,7 @@ class BinnedDataset:
         config = config or Config()
         n = len(indptr) - 1
         if mappers_all is None:
-            cnt = min(n, int(config.bin_construct_sample_cnt))
-            rng = np.random.RandomState(config.data_random_seed)
-            sample_idx = (
-                np.arange(n)
-                if cnt >= n
-                else np.sort(rng.choice(n, size=cnt, replace=False))
-            )
+            sample_idx = _sample_row_indices(n, config)
             mappers_all = find_bin_mappers_csr(
                 indptr, indices, values, num_cols, sample_idx,
                 max_bin=config.max_bin,
@@ -366,31 +384,35 @@ class BinnedDataset:
             return BinnedDataset._from_libsvm_sparse(
                 path, config, reference=reference, rank=rank
             )
+        single_machine = config.num_machines <= 1 or config.is_pre_partition
+        # auto-stream only for files too big to comfortably hold as f64
+        # (the flag is the explicit opt-in; dense LibSVM with weight/
+        # group columns keeps the one-shot parser)
+        want_stream = config.use_two_round_loading or (
+            os.path.getsize(path) > (4 << 30)
+        )
+        if want_stream and single_machine and fmt != "libsvm":
+            return BinnedDataset._from_file_streaming(
+                path, config, fmt, reference=reference
+            )
         raw, names = parse_file(path, has_header=config.has_header, fmt=fmt)
         side = Metadata.load_side_files(path)
 
         # ---- resolve column roles on the FULL file (dataset_loader.cpp:23-160)
-        label_col = _resolve_column(config.label_column, names)
-        if label_col is None:
-            label_col = 0
-        ignore = set(_resolve_column_list(config.ignore_column, names))
-        cats = _resolve_column_list(config.categorical_column, names)
-
+        label_col, ignore, cats, weight_col, group_col = _resolve_roles(
+            config, names
+        )
         n = raw.shape[0]
         label = raw[:, label_col].astype(np.float32)
-        weight_col = _resolve_column(config.weight_column, names)
-        group_col = _resolve_column(config.group_column, names)
         weights = side.get("weights")
         if weight_col is not None:
             weights = raw[:, weight_col].astype(np.float32)
-            ignore.add(weight_col)
         qb = side.get("query_boundaries")
         if group_col is not None:
             gid = raw[:, group_col].astype(np.int64)
             # contiguous group ids -> boundaries
             change = np.nonzero(np.diff(gid))[0] + 1
             qb = np.concatenate([[0], change, [n]])
-            ignore.add(group_col)
 
         feat_cols = [
             j for j in range(raw.shape[1]) if j != label_col and j not in ignore
@@ -433,12 +455,7 @@ class BinnedDataset:
             # with zero communication; with multiple attached processes the
             # feature-sharded finder + mapper allgather is used instead
             # (dataset_loader.cpp:692-755).
-            cnt = min(n, int(config.bin_construct_sample_cnt))
-            rng = np.random.RandomState(config.data_random_seed)
-            sample_idx = (
-                np.arange(n) if cnt >= n
-                else np.sort(rng.choice(n, size=cnt, replace=False))
-            )
+            sample_idx = _sample_row_indices(n, config)
             if jax.process_count() > 1:
                 mappers_all = distributed_find_bin_mappers(
                     X[sample_idx], rank, config.num_machines,
@@ -463,6 +480,140 @@ class BinnedDataset:
         # rank subset must never poison the shared cache path
         if config.is_save_binary_file and not distributed:
             ds.save_binary(bin_path)
+        return ds
+
+    @staticmethod
+    def _from_file_streaming(
+        path: str,
+        config: Config,
+        fmt: str,
+        reference: Optional["BinnedDataset"] = None,
+        chunk_rows: int = 200_000,
+    ) -> "BinnedDataset":
+        """Two-round loading (use_two_round_loading, dataset_loader.cpp:
+        181-209): round one streams chunks to pull the bin-construction
+        sample, round two streams again encoding each chunk straight into
+        the preallocated binned matrix.  Peak RSS is the binned matrix
+        plus one text chunk — never the whole file as float64.
+
+        The sampled row indices reuse the in-memory path's shared-seed
+        draw over the counted row total, so bin mappers (and therefore
+        trees) are bit-identical to non-streaming loading.
+        """
+        from .parser import (
+            _read_head,
+            count_data_rows,
+            parse_file_chunks,
+        )
+
+        names: Optional[List[str]] = None
+        if config.has_header:
+            head = _read_head(path, 1)
+            sep = "," if fmt == "csv" else None
+            names = [s.strip() for s in head[0].strip().split(sep)]
+        side = Metadata.load_side_files(path)
+        n = count_data_rows(path, config.has_header)
+
+        label_col, ignore, cats, weight_col, group_col = _resolve_roles(
+            config, names
+        )
+
+        feat_cols: Optional[List[int]] = None
+        mappers_all = None
+        if reference is None:
+            # ---- round 1: stream chunks, keep only the sampled rows
+            sample_idx = _sample_row_indices(n, config)
+            offset = 0
+            buf: List[np.ndarray] = []
+            for chunk in parse_file_chunks(path, config.has_header, fmt, chunk_rows):
+                if feat_cols is None:
+                    feat_cols = [
+                        j for j in range(chunk.shape[1])
+                        if j != label_col and j not in ignore
+                    ]
+                lo = np.searchsorted(sample_idx, offset)
+                hi = np.searchsorted(sample_idx, offset + len(chunk))
+                if hi > lo:
+                    buf.append(chunk[sample_idx[lo:hi] - offset][:, feat_cols])
+                offset += len(chunk)
+            sample_raw = np.vstack(buf)
+            cat_inner = [feat_cols.index(c) for c in cats if c in feat_cols]
+            mappers_all = find_bin_mappers(
+                sample_raw,
+                total_sample_cnt=len(sample_idx),
+                max_bin=config.max_bin,
+                categorical_features=cat_inner,
+            )
+            used_map = np.full(len(feat_cols), -1, dtype=np.int64)
+            used_mappers: List[BinMapper] = []
+            for j, m in enumerate(mappers_all):
+                if not m.is_trivial:
+                    used_map[j] = len(used_mappers)
+                    used_mappers.append(m)
+        else:
+            used_map = reference.used_feature_map
+            used_mappers = reference.bin_mappers
+
+        # ---- round 2: stream again, encoding chunks into the binned matrix
+        dtype = (
+            np.uint8
+            if max((m.num_bin for m in used_mappers), default=1) <= 256
+            else np.uint16
+        )
+        X_bin = np.empty((n, len(used_mappers)), dtype=dtype)
+        label = np.empty(n, np.float32)
+        weights = np.empty(n, np.float32) if weight_col is not None else None
+        gid = np.empty(n, np.int64) if group_col is not None else None
+        offset = 0
+        for chunk in parse_file_chunks(path, config.has_header, fmt, chunk_rows):
+            if feat_cols is None:
+                feat_cols = [
+                    j for j in range(chunk.shape[1])
+                    if j != label_col and j not in ignore
+                ]
+            m_rows = len(chunk)
+            X = chunk[:, feat_cols]
+            if reference is not None and X.shape[1] < len(used_map):
+                X = np.hstack(
+                    [X, np.zeros((m_rows, len(used_map) - X.shape[1]))]
+                )
+            _encode_bins(X, used_map, used_mappers, X_bin[offset:offset + m_rows])
+            label[offset:offset + m_rows] = chunk[:, label_col]
+            if weights is not None:
+                weights[offset:offset + m_rows] = chunk[:, weight_col]
+            if gid is not None:
+                gid[offset:offset + m_rows] = chunk[:, group_col]
+            offset += m_rows
+
+        qb = side.get("query_boundaries")
+        if gid is not None:
+            change = np.nonzero(np.diff(gid))[0] + 1
+            qb = np.concatenate([[0], change, [n]])
+        meta = Metadata(
+            label=label,
+            weights=side.get("weights") if weights is None else weights,
+            query_boundaries=qb,
+            init_score=side.get("init_score"),
+        )
+        fnames = (
+            [names[j] for j in feat_cols]
+            if names is not None
+            else None
+        )
+        if reference is not None:
+            return BinnedDataset(
+                X_bin,
+                reference.bin_mappers,
+                reference.used_feature_map,
+                reference.num_total_features,
+                meta,
+                reference.feature_names,
+            )
+        ds = BinnedDataset(
+            X_bin, used_mappers, used_map, len(feat_cols), meta, fnames
+        )
+        if config.is_save_binary_file:
+            ds.save_binary(path + ".bin")
         return ds
 
     @staticmethod
@@ -530,12 +681,7 @@ class BinnedDataset:
             # shared-seed sample over the FULL file gives every rank
             # identical mappers with zero communication (every rank
             # parsed the whole file when is_pre_partition=false)
-            cnt = min(n, int(config.bin_construct_sample_cnt))
-            rng = np.random.RandomState(config.data_random_seed)
-            sample_idx = (
-                np.arange(n) if cnt >= n
-                else np.sort(rng.choice(n, size=cnt, replace=False))
-            )
+            sample_idx = _sample_row_indices(n, config)
             mappers_all = find_bin_mappers_csr(
                 indptr, indices, values, num_cols, sample_idx,
                 max_bin=config.max_bin, categorical_features=cats,

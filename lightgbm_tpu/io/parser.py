@@ -77,8 +77,17 @@ def parse_file(
 
     import pandas as pd
 
-    # true tab-separated files keep pandas' fast C engine; arbitrary
-    # whitespace needs the python engine's regex separator
+    df = pd.read_csv(path, **_read_csv_kwargs(head, fmt, has_header))
+    names = [str(c) for c in df.columns] if has_header else None
+    return df.to_numpy(dtype=np.float64), names
+
+
+def _read_csv_kwargs(head: List[str], fmt: str, has_header: bool) -> dict:
+    """One source of truth for the pandas parse configuration, shared by
+    the one-shot and the chunked (two-round) loaders so both produce the
+    same matrix for the same file.  True tab-separated files keep pandas'
+    fast C engine; arbitrary whitespace needs the python engine's regex
+    separator."""
     probe = head[-1] if head else ""
     if fmt == "csv":
         sep, engine = ",", "c"
@@ -86,16 +95,13 @@ def parse_file(
         sep, engine = "\t", "c"
     else:
         sep, engine = r"\s+", "python"
-    df = pd.read_csv(
-        path,
+    return dict(
         sep=sep,
         header=0 if has_header else None,
         engine=engine,
         dtype=np.float64,
         na_values=["", "NA", "nan", "NaN"],
     )
-    names = [str(c) for c in df.columns] if has_header else None
-    return df.to_numpy(dtype=np.float64), names
 
 
 def _parse_libsvm(lines) -> np.ndarray:
@@ -126,6 +132,52 @@ def _parse_libsvm(lines) -> np.ndarray:
     for i, (idx, val) in enumerate(rows):
         out[i, idx + 1] = val
     return out
+
+
+def count_data_rows(path: str, has_header: bool = False) -> int:
+    """Count non-blank data lines by streaming 1MB blocks (TextReader-
+    style, include/LightGBM/utils/text_reader.h:144-288) — no parsing,
+    no whole-file buffer.  Blank lines are excluded to match pandas'
+    skip_blank_lines behavior in the chunked parser."""
+    n = 0
+    carry = b""
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(1 << 20)
+            if not block:
+                break
+            lines = (carry + block).split(b"\n")
+            carry = lines[-1]
+            n += sum(1 for ln in lines[:-1] if ln.strip())
+    if carry.strip():
+        n += 1  # unterminated final line
+    return n - (1 if has_header else 0)
+
+
+def parse_file_chunks(
+    path: str,
+    has_header: bool = False,
+    fmt: Optional[str] = None,
+    chunk_rows: int = 200_000,
+):
+    """Yield dense float64 row-matrix chunks of a CSV/TSV file.
+
+    The streamed half of two-round loading (dataset_loader.cpp:181-209):
+    peak memory is one chunk, not the file.  LibSVM streams through the
+    sparse CSR path instead (io/sparse.py).
+    """
+    head = _read_head(path, 2 if not has_header else 3)
+    if fmt is None:
+        fmt = detect_format(head[1:] if has_header else head)
+    if fmt == "libsvm":
+        raise ValueError("libsvm streams via the sparse CSR path")
+    import pandas as pd
+
+    reader = pd.read_csv(
+        path, chunksize=chunk_rows, **_read_csv_kwargs(head, fmt, has_header)
+    )
+    for df in reader:
+        yield df.to_numpy(dtype=np.float64)
 
 
 def parse_lines(lines: List[str], fmt: Optional[str] = None) -> np.ndarray:

@@ -38,9 +38,12 @@ def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, c
 
     bins_ref:  [F, C] uint8 (this chunk's bins, feature-major)
     stats_ref: [C, 4] f32   (g*m, h*m, m, 0)
-    out_ref:   [1, F, B, 4] f32 block at row ``leaf_of_chunk[c]`` —
+    out_ref:   [1, F, 4, B] f32 block at row ``leaf_of_chunk[c]`` —
                revisited (and therefore VMEM-resident) across all chunks
-               of the same leaf.
+               of the same leaf.  The stats axis sits in the SUBLANE dim
+               (padded 4->8) and the bin axis in the LANE dim: the
+               [4, C] x [C, B] matmul then wastes only 2x of the MXU,
+               where the transposed form would pad 4 lanes to 128 (32x).
     """
     c = pl.program_id(0)
     prev = leaf_of_chunk[jnp.maximum(c - 1, 0)]
@@ -67,9 +70,9 @@ def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, c
             row = blk[i, :].reshape(chunk, 1)
             onehot = (row == iota_b).astype(jnp.float32)  # [C, B]
             contrib = jax.lax.dot_general(
-                onehot, stats, (((0,), (0,)), ((), ())),
+                stats, onehot, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )  # [B, 4]
+            )  # [4, B]
             out_ref[0, g * FGROUP + i] = out_ref[0, g * FGROUP + i] + contrib
         return 0
 
@@ -159,17 +162,18 @@ def histogram_by_leaf_sorted(
             pl.BlockSpec((C, 4), lambda c, leaf_ref: (c, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, Fp, B, 4), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
+            (1, Fp, 4, B), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
         ),
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((L + 1, Fp, B, 4), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((L + 1, Fp, 4, B), jnp.float32),
         interpret=interpret,
     )(leaf_of_chunk, bins_buf, stats_buf)
 
-    return out[:L, :F, :num_bins, :3]
+    # [L, F, 4, B] -> [L, F, B, 3] (stats back to the trailing axis)
+    return out[:L, :F, :3, :num_bins].transpose(0, 1, 3, 2)
 
 
 @functools.lru_cache(maxsize=None)
