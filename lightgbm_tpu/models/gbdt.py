@@ -164,7 +164,10 @@ class GBDT:
                     hist_fn=self._depthwise_hist_fn(),
                 )
             return functools.partial(
-                grow_tree, num_bins=self._num_bins, max_leaves=self.max_leaves
+                grow_tree,
+                num_bins=self._num_bins,
+                max_leaves=self.max_leaves,
+                hist_fn=self._leafwise_hist_fn(),
             )
         from ..parallel import (
             data_mesh,
@@ -205,10 +208,24 @@ class GBDT:
             impl == "auto" and jax.default_backend() == "tpu"
         )
 
+    def _leafwise_hist_fn(self):
+        """Histogram implementation for leaf-wise growth: the single-leaf
+        MXU matmul kernel on TPU (the gathered smaller-child buffer is
+        one leaf's rows, so no sort is needed), segment_sum elsewhere.
+        The f64 reference-parity accumulation keeps segment_sum — the
+        Pallas kernel is f32."""
+        if self._use_matmul_hist() and not self._use_f64_hist:
+            from ..ops.pallas_histogram import make_single_hist_fn
+
+            return make_single_hist_fn(self._num_bins)
+        return None  # grower's default segment_sum path
+
     def _depthwise_hist_fn(self):
         """Histogram implementation for depthwise growth (config.hist_impl):
-        the leaf-sorted MXU matmul kernel on TPU, segment_sum elsewhere."""
-        if self._use_matmul_hist():
+        the leaf-sorted MXU matmul kernel on TPU, segment_sum elsewhere.
+        f64 reference-parity accumulation keeps segment_sum — the Pallas
+        kernels are f32 (same gate as _leafwise_hist_fn)."""
+        if self._use_matmul_hist() and not self._use_f64_hist:
             from ..ops.pallas_histogram import make_sorted_hist_fn
 
             return make_sorted_hist_fn(self._num_bins)

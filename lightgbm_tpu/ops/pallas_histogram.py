@@ -86,6 +86,33 @@ def _pad_pow(b: int) -> int:
     return ((b + 127) // 128) * 128
 
 
+def _hist_pallas_call(
+    leaf_of_chunk, bins_buf, stats_buf, out_leaves, Fp, B, C, n_chunks,
+    interpret,
+):
+    """Shared pallas_call scaffolding for both histogram kernels: one
+    grid step per C-row chunk, output block (1, Fp, 4, B) indexed by the
+    scalar-prefetched chunk->leaf map."""
+    kernel = functools.partial(_hist_kernel, num_f=Fp, num_b=B, chunk=C)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((Fp, C), lambda c, leaf_ref: (0, c)),
+            pl.BlockSpec((C, 4), lambda c, leaf_ref: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Fp, 4, B), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_leaves, Fp, 4, B), jnp.float32),
+        interpret=interpret,
+    )(leaf_of_chunk, bins_buf, stats_buf)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_bins", "num_leaves", "chunk", "interpret"),
@@ -153,27 +180,78 @@ def histogram_by_leaf_sorted(
     ).astype(jnp.int32)
     leaf_of_chunk = jnp.where(cidx < chunk_start[L], leaf_of_chunk, L)
 
-    kernel = functools.partial(_hist_kernel, num_f=Fp, num_b=B, chunk=C)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_chunks,),
-        in_specs=[
-            pl.BlockSpec((Fp, C), lambda c, leaf_ref: (0, c)),
-            pl.BlockSpec((C, 4), lambda c, leaf_ref: (c, 0)),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, Fp, 4, B), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
-        ),
+    out = _hist_pallas_call(
+        leaf_of_chunk, bins_buf, stats_buf, L + 1, Fp, B, C, n_chunks,
+        interpret,
     )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((L + 1, Fp, 4, B), jnp.float32),
-        interpret=interpret,
-    )(leaf_of_chunk, bins_buf, stats_buf)
-
     # [L, F, 4, B] -> [L, F, B, 3] (stats back to the trailing axis)
     return out[:L, :F, :3, :num_bins].transpose(0, 1, 3, 2)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "interpret")
+)
+def histogram_single_leaf(
+    bins_T: jax.Array,  # [F, cap] binned rows of ONE leaf (masked)
+    grad: jax.Array,  # [cap]
+    hess: jax.Array,  # [cap]
+    mask: jax.Array,  # [cap] 0/1 validity
+    num_bins: int,
+    chunk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """hist[F, num_bins, 3] for a single row set — the leaf-wise
+    learner's per-split histogram (DenseBin::ConstructHistogram over the
+    smaller child's gathered rows, dense_bin.hpp:39-104).  Same one-hot
+    MXU matmul as the sorted kernel but with a trivial chunk->leaf map:
+    every chunk accumulates into the one output block, so no sort, no
+    scatter — just O(cap x B x F) dense MACs.
+    """
+    F, cap = bins_T.shape
+    # the block width must stay lane-aligned whatever cap is — an
+    # unaligned int8 block is the Mosaic failure class the FGROUP loop
+    # exists to avoid
+    C = max(128, (chunk // 128) * 128)
+    B = _pad_pow(num_bins)
+    Fp = ((F + FGROUP - 1) // FGROUP) * FGROUP
+    if Fp != F:
+        bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
+    pad = (-cap) % C
+    if pad:
+        bins_T = jnp.pad(bins_T, ((0, 0), (0, pad)))
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+    n_chunks = (cap + pad) // C
+
+    gm = grad * mask
+    hm = hess * mask
+    stats = jnp.stack(
+        [gm, hm, mask, jnp.zeros_like(mask)], axis=-1
+    ).astype(jnp.float32)
+
+    out = _hist_pallas_call(
+        jnp.zeros(n_chunks, jnp.int32), bins_T, stats, 1, Fp, B, C,
+        n_chunks, interpret,
+    )
+    return out[0, :F, :3, :num_bins].transpose(0, 2, 1)
+
+
+@functools.lru_cache(maxsize=None)
+def make_single_hist_fn(num_bins: int, chunk: int = 512):
+    """hist_fn for the leaf-wise grower (signature: bins_T, grad, hess,
+    mask -> [F, B, 3]) backed by the single-leaf MXU kernel.  Cached per
+    config so repeated boosters reuse the jit cache (see
+    make_sorted_hist_fn)."""
+    interpret = jax.default_backend() != "tpu"
+
+    def hist_fn(bins_T, grad, hess, mask):
+        return histogram_single_leaf(
+            bins_T, grad, hess, mask,
+            num_bins=num_bins, chunk=chunk, interpret=interpret,
+        )
+
+    return hist_fn
 
 
 @functools.lru_cache(maxsize=None)
