@@ -385,9 +385,18 @@ class GBDT:
         else:
             scores = self._valid_scores[data_idx - 1]
             metrics = self.valid_metrics[data_idx - 1]
-        s = np.asarray(scores)
-        s = s if self.num_class > 1 else s[0]
-        return {m.name: m.eval(s) for m in metrics}
+        dev = scores if self.num_class > 1 else scores[0]
+        out: Dict[str, float] = {}
+        host = None
+        for m in metrics:
+            if m.eval_jax is not None:
+                # device path: scores stay in HBM, one scalar returns
+                out[m.name] = float(m.eval_jax_jit(dev))
+            else:
+                if host is None:
+                    host = np.asarray(dev)
+                out[m.name] = m.eval(host)
+        return out
 
     def predict_at(self, data_idx: int) -> np.ndarray:
         scores = self._scores if data_idx == 0 else self._valid_scores[data_idx - 1]
