@@ -51,9 +51,11 @@ class Predictor:
         self.is_raw_score = is_raw_score
         self.is_leaf = is_predict_leaf_index
 
-    def predict_file(self, data_path: str, result_path: str, has_header: bool = False) -> None:
+    def predict_file(self, data_path: str, result_path: str, has_header: bool = False,
+                     num_iteration: int = -1) -> None:
         out = self.booster.predict(
             data_path,
+            num_iteration=num_iteration,
             raw_score=self.is_raw_score,
             pred_leaf=self.is_leaf,
             data_has_header=has_header,
@@ -222,7 +224,10 @@ def run_predict(cfg: Config) -> None:
     t0 = time.perf_counter()
     Predictor(
         booster, cfg.is_predict_raw_score, cfg.is_predict_leaf_index
-    ).predict_file(cfg.data, cfg.output_result, cfg.has_header)
+    ).predict_file(
+        cfg.data, cfg.output_result, cfg.has_header,
+        num_iteration=cfg.num_iteration_predict,
+    )
     Log.info(
         f"Finish prediction, use {time.perf_counter() - t0:.6f} seconds; "
         f"saved to {cfg.output_result}"

@@ -155,6 +155,9 @@ class Config:
     output_model: str = "LightGBM_model.txt"
     input_model: str = ""
     output_result: str = "LightGBM_predict_result.txt"
+    # use only the first N iterations at prediction time (config.h:102,
+    # SetNumIterationForPred); <= 0 means all
+    num_iteration_predict: int = -1
     verbose: int = 1
     has_header: bool = False
     label_column: str = ""

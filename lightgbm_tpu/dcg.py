@@ -29,6 +29,21 @@ def position_discounts(n: int) -> np.ndarray:
     return 1.0 / np.log2(2.0 + np.arange(n, dtype=np.float64))
 
 
+def build_padded_query_layout(qb: np.ndarray, num_data: int):
+    """Padded [nq, Q] row-index matrix shared by the lambdarank objective
+    and the NDCG metric: row q holds that query's row indices, padding
+    cells point at the sentinel slot ``num_data``.  Returns
+    (pad_idx int64[nq, Q], lens int64[nq])."""
+    qb = np.asarray(qb)
+    lens = np.diff(qb)
+    nq = len(lens)
+    Q = int(lens.max()) if nq else 1
+    pad_idx = np.full((nq, Q), num_data, np.int64)
+    for q in range(nq):
+        pad_idx[q, : lens[q]] = np.arange(qb[q], qb[q + 1])
+    return pad_idx, lens
+
+
 def max_dcg_at_k(k: int, labels: np.ndarray, gains: np.ndarray) -> float:
     """CalMaxDCGAtK (dcg_calculator.cpp:34-56): ideal DCG using labels
     sorted descending."""

@@ -6,7 +6,13 @@ from typing import List
 
 import numpy as np
 
-from .dcg import dcg_at_k, label_gains_from_config, max_dcg_at_k
+from .dcg import (
+    build_padded_query_layout,
+    dcg_at_k,
+    label_gains_from_config,
+    max_dcg_at_k,
+    position_discounts,
+)
 from .metrics import Metric
 
 
@@ -39,11 +45,30 @@ class NDCGMetric(Metric):
             lab = self.label[self.qb[q] : self.qb[q + 1]]
             for ki, k in enumerate(self.eval_at):
                 self.max_dcgs[q, ki] = max_dcg_at_k(k, lab, self.gains)
+        # padded [nq, Q] layout for the vectorized eval (shared with the
+        # lambdarank objective): padding cells point at the sentinel slot
+        # n, whose score sorts last and whose gain is 0, so they never
+        # contribute to any DCG@k.  Guard against skewed group sizes —
+        # one giant query among many small ones makes nq*Q explode — by
+        # falling back to the per-query loop when padding inflates the
+        # work more than ~8x over the O(n) loop.
+        pad_idx, lens = build_padded_query_layout(self.qb, num_data)
+        self._use_padded = nq == 0 or pad_idx.size <= 8 * max(num_data, 1)
+        if not self._use_padded:
+            return
+        self._pad_idx = pad_idx
+        valid = pad_idx < num_data
+        lab_idx = np.minimum(
+            self.label[np.minimum(pad_idx, num_data - 1)].astype(np.int64),
+            len(self.gains) - 1,
+        )
+        self._gain_padded = np.where(valid, self.gains[lab_idx], 0.0)
+        self._discounts = position_discounts(pad_idx.shape[1])
 
-    def eval_multi(self, scores) -> List[float]:
-        scores = np.asarray(scores, np.float64).reshape(-1)
-        nq = len(self.qb) - 1
+    def _eval_multi_loop(self, scores) -> List[float]:
+        """O(n) per-query fallback for heavily skewed query sizes."""
         acc = np.zeros(len(self.eval_at))
+        nq = len(self.qb) - 1
         for q in range(nq):
             beg, end = self.qb[q], self.qb[q + 1]
             lab = self.label[beg:end]
@@ -57,6 +82,34 @@ class NDCGMetric(Metric):
                         w * dcg_at_k(k, lab[order], self.gains) / self.max_dcgs[q, ki]
                     )
         return [float(a / self.sum_query_weights) for a in acc]
+
+    def eval_multi(self, scores) -> List[float]:
+        """Vectorized over queries: one padded argsort + gather replaces
+        the per-query python loop (rank_metric.hpp's per-thread
+        accumulators collapse into matrix ops)."""
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        if not self._use_padded:
+            return self._eval_multi_loop(scores)
+        nq, Q = self._pad_idx.shape
+        sp = np.concatenate([scores, [-np.inf]])  # sentinel slot n;
+        # every pad cell maps there via the min(), so no extra masking
+        qs = sp[np.minimum(self._pad_idx, len(scores))]
+        order = np.argsort(-qs, axis=1, kind="stable")
+        g = np.take_along_axis(self._gain_padded, order, axis=1)  # [nq, Q]
+        gd = g * self._discounts[None, :]
+        cum = np.cumsum(gd, axis=1)  # cum[:, k-1] = DCG@k
+        w = (
+            np.ones(nq)
+            if self.query_weights is None
+            else np.asarray(self.query_weights, np.float64)
+        )
+        out = []
+        for ki, k in enumerate(self.eval_at):
+            dcg = cum[:, min(k, Q) - 1] if Q else np.zeros(nq)
+            maxd = self.max_dcgs[:, ki]
+            ndcg = np.where(maxd > 0, dcg / np.maximum(maxd, 1e-300), 1.0)
+            out.append(float((ndcg * w).sum() / self.sum_query_weights))
+        return out
 
     def eval(self, scores) -> float:
         return self.eval_multi(scores)[0]

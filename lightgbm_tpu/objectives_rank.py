@@ -47,15 +47,13 @@ class LambdarankNDCG(ObjectiveFunction):
         qb = np.asarray(metadata.query_boundaries)
         label_np = np.asarray(metadata.label)
         nq = len(qb) - 1
-        sizes = np.diff(qb)
-        Q = int(sizes.max())
         # padded row-index matrix; padding points at n (dropped on scatter)
-        pad_idx = np.full((nq, Q), num_data, np.int32)
-        valid = np.zeros((nq, Q), bool)
-        for q in range(nq):
-            c = sizes[q]
-            pad_idx[q, :c] = np.arange(qb[q], qb[q + 1])
-            valid[q, :c] = True
+        from .dcg import build_padded_query_layout
+
+        pad_idx64, sizes = build_padded_query_layout(qb, num_data)
+        pad_idx = pad_idx64.astype(np.int32)
+        Q = pad_idx.shape[1]
+        valid = pad_idx64 < num_data
         inv_max_dcg = np.zeros(nq, np.float64)
         for q in range(nq):
             m = max_dcg_at_k(

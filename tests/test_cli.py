@@ -121,3 +121,35 @@ def test_bad_config_fails(tmp_path):
     rc = main(["task=train", "data=/definitely/missing.csv",
                f"output_model={tmp_path}/m.txt"])
     assert rc == 1
+
+
+def test_num_iteration_predict(tmp_path):
+    """num_iteration_predict limits prediction to the first N trees
+    (config.h:102, SetNumIterationForPred)."""
+    import numpy as np
+    from lightgbm_tpu.cli import main
+
+    rng = np.random.RandomState(8)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] > 0).astype(np.float64)
+    data = str(tmp_path / "d.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    model = str(tmp_path / "m.txt")
+    assert main([
+        "task=train", f"data={data}", "objective=binary", "num_trees=5",
+        "num_leaves=7", f"output_model={model}", "is_save_binary_file=false",
+        "min_data_in_leaf=5",
+    ]) == 0
+    full = str(tmp_path / "full.txt")
+    lim = str(tmp_path / "lim.txt")
+    assert main(["task=predict", f"data={data}", f"input_model={model}",
+                 f"output_result={full}"]) == 0
+    assert main(["task=predict", f"data={data}", f"input_model={model}",
+                 "num_iteration_predict=2", f"output_result={lim}"]) == 0
+    pf = np.loadtxt(full)
+    pl = np.loadtxt(lim)
+    assert not np.allclose(pf, pl)  # fewer trees -> different scores
+
+    from lightgbm_tpu.basic import Booster
+    ref = Booster(model_file=model).predict(X, num_iteration=2)
+    np.testing.assert_allclose(pl, ref, rtol=1e-5)
