@@ -38,7 +38,9 @@ def build_padded_query_layout(qb: np.ndarray, num_data: int):
     lens = np.diff(qb)
     nq = len(lens)
     Q = int(lens.max()) if nq else 1
-    pad_idx = np.full((nq, Q), num_data, np.int64)
+    # int32 is enough for row indices and halves the peak footprint
+    # (callers needing int64 can cast the small result)
+    pad_idx = np.full((nq, Q), num_data, np.int32)
     for q in range(nq):
         pad_idx[q, : lens[q]] = np.arange(qb[q], qb[q + 1])
     return pad_idx, lens

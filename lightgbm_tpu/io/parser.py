@@ -171,6 +171,15 @@ def parse_file_chunks(
         fmt = detect_format(head[1:] if has_header else head)
     if fmt == "libsvm":
         raise ValueError("libsvm streams via the sparse CSR path")
+
+    # native OpenMP chunk reader (src/native/lgbm_native.cpp); pandas
+    # fallback keeps identical NA/short-line semantics
+    from .. import native
+
+    native_gen = native.parse_file_chunks(path, fmt, has_header, chunk_rows)
+    if native_gen is not None:
+        yield from native_gen
+        return
     import pandas as pd
 
     reader = pd.read_csv(

@@ -52,10 +52,14 @@ class NDCGMetric(Metric):
         # one giant query among many small ones makes nq*Q explode — by
         # falling back to the per-query loop when padding inflates the
         # work more than ~8x over the O(n) loop.
-        pad_idx, lens = build_padded_query_layout(self.qb, num_data)
-        self._use_padded = nq == 0 or pad_idx.size <= 8 * max(num_data, 1)
+        lens = np.diff(self.qb)
+        Q = int(lens.max()) if nq else 1
+        # decide BEFORE allocating: the guard would be pointless if the
+        # nq x Q matrix it protects against already existed
+        self._use_padded = nq == 0 or nq * Q <= 8 * max(num_data, 1)
         if not self._use_padded:
             return
+        pad_idx, _ = build_padded_query_layout(self.qb, num_data)
         self._pad_idx = pad_idx
         valid = pad_idx < num_data
         lab_idx = np.minimum(

@@ -94,6 +94,17 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.lgbm_free.restype = None
         lib.lgbm_free.argtypes = [ctypes.c_void_p]
+        lib.lgbm_chunk_open.restype = ctypes.c_void_p
+        lib.lgbm_chunk_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.lgbm_chunk_next.restype = ctypes.c_long
+        lib.lgbm_chunk_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+        ]
+        lib.lgbm_chunk_close.restype = None
+        lib.lgbm_chunk_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -136,6 +147,46 @@ def parse_file(path: str, fmt: str, skip_header: bool) -> Optional[np.ndarray]:
     finally:
         lib.lgbm_free(data_p)
     return out
+
+
+def parse_file_chunks(path: str, fmt: str, skip_header: bool,
+                      chunk_rows: int):
+    """Streaming chunk parse (the native half of two-round loading,
+    text_reader.h:144-288 semantics).  Yields row-major float64 chunks.
+    Returns None when unavailable so the caller uses the pandas reader;
+    raises ValueError on malformed rows mid-stream (matching the strict
+    whole-file native parser's fallback-to-python contract is impossible
+    once chunks have been handed out)."""
+    lib = _load()
+    if lib is None or fmt == "libsvm":
+        return None
+    cols = ctypes.c_long()
+    handle = lib.lgbm_chunk_open(path.encode(), 1 if fmt == "csv" else 2,
+                                 int(skip_header), ctypes.byref(cols))
+    if not handle:
+        return None
+    if cols.value <= 0:  # empty file
+        lib.lgbm_chunk_close(handle)
+        return iter(())
+
+    def gen():
+        try:
+            while True:
+                buf = np.empty((chunk_rows, cols.value), np.float64)
+                got = lib.lgbm_chunk_next(
+                    handle,
+                    buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    chunk_rows,
+                )
+                if got < 0:
+                    raise ValueError(f"malformed data row in {path}")
+                if got == 0:
+                    return
+                yield buf[:got]
+        finally:
+            lib.lgbm_chunk_close(handle)
+
+    return gen()
 
 
 def value_to_bin_numerical(

@@ -50,10 +50,9 @@ class LambdarankNDCG(ObjectiveFunction):
         # padded row-index matrix; padding points at n (dropped on scatter)
         from .dcg import build_padded_query_layout
 
-        pad_idx64, sizes = build_padded_query_layout(qb, num_data)
-        pad_idx = pad_idx64.astype(np.int32)
+        pad_idx, sizes = build_padded_query_layout(qb, num_data)
         Q = pad_idx.shape[1]
-        valid = pad_idx64 < num_data
+        valid = pad_idx < num_data
         inv_max_dcg = np.zeros(nq, np.float64)
         for q in range(nq):
             m = max_dcg_at_k(

@@ -68,16 +68,21 @@ inline bool IsSep(char c, char sep) {
   return sep == ' ' ? (c == ' ' || c == '\t') : c == sep;
 }
 
-// Recognized NA spellings (the python path's pandas na_values set:
-// io/parser.py — "", "NA", "nan", "NaN").
+// Recognized NA spellings: pandas' default NA set plus the explicit
+// na_values the python fallback passes (io/parser.py) — the two readers
+// must accept the SAME tokens or a file parses under one and hard-fails
+// under the other.
 bool IsNaToken(const char* p, const char* end) {
   size_t len = static_cast<size_t>(end - p);
   if (len == 0) return true;
-  if (len == 2 && p[0] == 'N' && p[1] == 'A') return true;
-  if (len == 3 && (std::strncmp(p, "nan", 3) == 0 ||
-                   std::strncmp(p, "NaN", 3) == 0 ||
-                   std::strncmp(p, "NAN", 3) == 0))
-    return true;
+  static const char* kNa[] = {
+      "NA",   "N/A", "NaN",  "nan",  "NULL", "null", "None", "n/a",
+      "<NA>", "#NA", "#N/A", "-NaN", "-nan", "NaT",
+  };
+  for (const char* na : kNa) {
+    size_t nl = std::strlen(na);
+    if (len == nl && std::strncmp(p, na, nl) == 0) return true;
+  }
   return false;
 }
 
@@ -359,6 +364,185 @@ int lgbm_num_threads() {
 #else
   return 1;
 #endif
+}
+
+// ---------------------------------------------------------------------
+// Chunked streaming reader — the native half of two-round loading
+// (reference TextReader/PipelineReader, utils/text_reader.h:144-288 +
+// dataset_loader.cpp:181-209): rows are parsed block by block so peak
+// memory is one block + the caller's chunk buffer, never the file.
+
+namespace {
+
+constexpr size_t kBlockBytes = 4 << 20;  // 4MB read granularity
+
+struct ChunkReader {
+  FILE* fp = nullptr;
+  char sep = ',';
+  long cols = 0;
+  bool sep_known = false;
+  std::vector<char> carry;  // unconsumed text (partial or surplus lines)
+  bool eof = false;
+};
+
+// Establish sep + column count from the first non-empty line.
+bool SniffLine(const char* s, const char* end, int fmt, char* sep,
+               long* cols) {
+  char sp = ',';
+  if (fmt != 1) {
+    sp = ' ';
+    for (const char* p = s; p < end; ++p)
+      if (*p == '\t') {
+        sp = '\t';
+        break;
+      }
+  }
+  long c = CountFields(s, end, sp);
+  if (c <= 0) return false;
+  *sep = sp;
+  *cols = c;
+  return true;
+}
+
+}  // namespace
+
+void* lgbm_chunk_open(const char* path, int fmt, int skip_header,
+                      long* out_cols) {
+  FILE* fp = std::fopen(path, "rb");
+  if (fp == nullptr) return nullptr;
+  ChunkReader* r = new ChunkReader();
+  r->fp = fp;
+  // pull blocks until the header (if any) and one full data line are seen
+  std::vector<char> buf;
+  long skipped = skip_header ? 0 : 1;  // 0 = header still pending
+  while (true) {
+    size_t off = buf.size();
+    buf.resize(off + kBlockBytes);
+    size_t got = std::fread(buf.data() + off, 1, kBlockBytes, fp);
+    buf.resize(off + got);
+    if (got == 0) r->eof = true;
+    // find first data line
+    size_t start = 0;
+    for (size_t i = 0; i <= buf.size(); ++i) {
+      if (i == buf.size() && !r->eof) break;  // need more data
+      if (i == buf.size() || buf[i] == '\n') {
+        size_t end = i;
+        if (end > start && buf[end - 1] == '\r') --end;
+        bool blank = true;
+        for (size_t k = start; k < end; ++k)
+          if (!std::isspace(static_cast<unsigned char>(buf[k]))) blank = false;
+        if (!blank && skipped == 0) {
+          skipped = 1;  // header consumed: drop it from the carry
+          r->carry.assign(buf.begin() + (i == buf.size() ? i : i + 1),
+                          buf.end());
+          buf = r->carry;
+          start = 0;
+          i = static_cast<size_t>(-1);  // restart scan on remaining text
+          continue;
+        }
+        if (!blank) {
+          if (!SniffLine(buf.data() + start, buf.data() + end, fmt, &r->sep,
+                         &r->cols)) {
+            std::fclose(fp);
+            delete r;
+            return nullptr;
+          }
+          r->sep_known = true;
+          r->carry = std::move(buf);
+          *out_cols = r->cols;
+          return r;
+        }
+        start = i + 1;
+      }
+    }
+    if (r->eof) {  // empty (or header-only) file
+      r->carry = std::move(buf);
+      *out_cols = 0;
+      return r;
+    }
+  }
+}
+
+// Parse up to max_rows rows into out (row-major [max_rows, cols]).
+// Returns rows parsed; 0 at EOF; -1 on malformed input (caller falls
+// back to the strict python reader / raises).
+long lgbm_chunk_next(void* handle, double* out, long max_rows) {
+  ChunkReader* r = static_cast<ChunkReader*>(handle);
+  if (r->cols == 0) return 0;
+  // top up the carry until it holds max_rows complete lines or EOF.
+  // Count incrementally — only freshly read bytes are scanned, so the
+  // loop stays linear in the chunk size.
+  auto count_in_range = [&](size_t beg, size_t endpos) {
+    long cnt = 0;
+    size_t start = beg;
+    for (size_t i = beg; i < endpos; ++i) {
+      if (r->carry[i] == '\n') {
+        size_t end = i;
+        if (end > start && r->carry[end - 1] == '\r') --end;
+        bool blank = true;
+        for (size_t k = start; k < end; ++k)
+          if (!std::isspace(static_cast<unsigned char>(r->carry[k])))
+            blank = false;
+        if (!blank) ++cnt;
+        start = i + 1;
+      }
+    }
+    return cnt;
+  };
+  // scanning must restart at the line START containing the first
+  // unscanned byte, so track the last newline seen instead of raw bytes
+  long complete = count_in_range(0, r->carry.size());
+  while (!r->eof && complete < max_rows) {
+    size_t off = r->carry.size();
+    size_t line_start = off;
+    while (line_start > 0 && r->carry[line_start - 1] != '\n') --line_start;
+    r->carry.resize(off + kBlockBytes);
+    size_t got = std::fread(r->carry.data() + off, 1, kBlockBytes, r->fp);
+    r->carry.resize(off + got);
+    if (got == 0) r->eof = true;
+    complete += count_in_range(line_start, r->carry.size());
+  }
+  // split the carry into lines; keep surplus + partial tail
+  std::vector<std::pair<size_t, size_t>> lines;
+  size_t consumed = 0;
+  size_t start = 0;
+  for (size_t i = 0; i <= r->carry.size(); ++i) {
+    bool is_end = (i == r->carry.size());
+    if (is_end && !r->eof) break;  // partial tail stays in carry
+    if (is_end || r->carry[i] == '\n') {
+      size_t end = i;
+      if (end > start && r->carry[end - 1] == '\r') --end;
+      bool blank = true;
+      for (size_t k = start; k < end; ++k)
+        if (!std::isspace(static_cast<unsigned char>(r->carry[k])))
+          blank = false;
+      if (!blank) {
+        if (static_cast<long>(lines.size()) >= max_rows) break;
+        lines.emplace_back(start, end);
+      }
+      consumed = is_end ? i : i + 1;
+      start = i + 1;
+    }
+  }
+  long n = static_cast<long>(lines.size());
+  if (n == 0) return 0;
+  int bad = 0;
+#pragma omp parallel for schedule(static) reduction(| : bad)
+  for (long i = 0; i < n; ++i) {
+    if (!ParseDelimited(r->carry.data() + lines[i].first,
+                        r->carry.data() + lines[i].second, r->sep,
+                        out + i * r->cols, r->cols))
+      bad |= 1;
+  }
+  if (bad) return -1;
+  r->carry.erase(r->carry.begin(), r->carry.begin() + consumed);
+  return n;
+}
+
+void lgbm_chunk_close(void* handle) {
+  ChunkReader* r = static_cast<ChunkReader*>(handle);
+  if (r->fp) std::fclose(r->fp);
+  delete r;
 }
 
 }  // extern "C"

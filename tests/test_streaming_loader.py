@@ -110,3 +110,48 @@ def test_streaming_valid_alignment(tmp_path):
     )
     np.testing.assert_array_equal(v_str.X_bin, v_mem.X_bin)
     np.testing.assert_allclose(v_str.metadata.label, v_mem.metadata.label)
+
+
+def test_native_chunk_reader_matches_pandas(tmp_path):
+    """The native OpenMP chunk reader yields byte-identical chunks to the
+    pandas fallback on headers, blank lines, CRLF, NA tokens, and an
+    unterminated final line."""
+    from lightgbm_tpu import native
+
+    p = str(tmp_path / "n.csv")
+    with open(p, "w") as fh:
+        fh.write("a,b,c\r\n")
+        fh.write("1,2.5,3\n\n")
+        fh.write("4,NA,6\r\n")
+        fh.write("7,,9\n")
+        fh.write("nan,8,1.5e3\n")
+        fh.write("10,11,12")  # no trailing newline
+    gen = native.parse_file_chunks(p, "csv", True, 2)
+    if gen is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    chunks = list(gen)
+    assert [len(c) for c in chunks] == [2, 2, 1]
+    got = np.vstack(chunks)
+    import pandas as pd
+
+    want = pd.read_csv(p, dtype=np.float64, na_values=["", "NA", "nan", "NaN"])
+    np.testing.assert_array_equal(got, want.to_numpy())
+
+
+def test_native_chunk_reader_malformed_raises(tmp_path):
+    from lightgbm_tpu import native
+
+    p = str(tmp_path / "bad.csv")
+    with open(p, "w") as fh:
+        fh.write("1,2,3\n4,five,6\n")
+    gen = native.parse_file_chunks(p, "csv", False, 10)
+    if gen is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    import pytest
+
+    with pytest.raises(ValueError):
+        list(gen)
