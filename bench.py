@@ -232,8 +232,13 @@ def ours_sec_per_tree(X, y) -> tuple[float, float, str]:
         if not booster._use_matmul_hist():
             raise
         log(f"warmup failed ({type(e).__name__}: {str(e)[:300]}); "
-            "retrying with hist_impl=segment")
+            "retrying with depthwise + hist_impl=segment")
+        # the known-good fallback: level-synchronous growth over
+        # segment_sum histograms (measured end-to-end on the chip);
+        # leafwise + segment does one scatter pass per SPLIT and is far
+        # slower than either Pallas mode
         cfg.hist_impl = "segment"
+        cfg.tree_growth = "depthwise"
         booster = GBDT(cfg, ds, obj)
         booster.train_one_iter()
         _ = np.asarray(booster._scores)

@@ -148,10 +148,7 @@ class GBDT:
                 num_bins=self._num_bins,
                 max_leaves=self.max_leaves,
                 growth=self.config.tree_growth,
-                sorted_hist=(
-                    self.config.tree_growth == "depthwise"
-                    and self._use_matmul_hist()
-                ),
+                sorted_hist=self._use_pallas_hist(),
             )
         if tl == "serial" or len(jax.devices()) == 1:
             if self.config.tree_growth == "depthwise":
@@ -196,10 +193,7 @@ class GBDT:
             num_bins=self._num_bins,
             max_leaves=self.max_leaves,
             growth=self.config.tree_growth,
-            sorted_hist=(
-                self.config.tree_growth == "depthwise"
-                and self._use_matmul_hist()
-            ),
+            sorted_hist=self._use_pallas_hist(),
         )
 
     def _use_matmul_hist(self) -> bool:
@@ -208,13 +202,19 @@ class GBDT:
             impl == "auto" and jax.default_backend() == "tpu"
         )
 
+    def _use_pallas_hist(self) -> bool:
+        """ONE eligibility rule for the f32 Pallas MXU histogram kernels:
+        requested (or auto-on-TPU) and not overridden by the f64
+        reference-parity accumulation mode."""
+        return self._use_matmul_hist() and not self._use_f64_hist
+
     def _leafwise_hist_fn(self):
         """Histogram implementation for leaf-wise growth: the single-leaf
         MXU matmul kernel on TPU (the gathered smaller-child buffer is
         one leaf's rows, so no sort is needed), segment_sum elsewhere.
         The f64 reference-parity accumulation keeps segment_sum — the
         Pallas kernel is f32."""
-        if self._use_matmul_hist() and not self._use_f64_hist:
+        if self._use_pallas_hist():
             from ..ops.pallas_histogram import make_single_hist_fn
 
             return make_single_hist_fn(self._num_bins)
@@ -225,7 +225,7 @@ class GBDT:
         the leaf-sorted MXU matmul kernel on TPU, segment_sum elsewhere.
         f64 reference-parity accumulation keeps segment_sum — the Pallas
         kernels are f32 (same gate as _leafwise_hist_fn)."""
-        if self._use_matmul_hist() and not self._use_f64_hist:
+        if self._use_pallas_hist():
             from ..ops.pallas_histogram import make_sorted_hist_fn
 
             return make_sorted_hist_fn(self._num_bins)

@@ -147,3 +147,37 @@ def test_leafwise_training_matmul_vs_segment():
         preds[impl] = bst.predict(X)
     np.testing.assert_allclose(preds["matmul"], preds["segment"],
                                rtol=1e-4, atol=1e-5)
+
+
+def test_data_parallel_leafwise_matmul_hist():
+    """Leaf-wise data-parallel growth with per-shard single-leaf MXU
+    histograms (+psum) matches the single-device leaf-wise tree."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.learners.serial import TreeLearnerParams, grow_tree
+    from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower
+
+    rng = np.random.RandomState(7)
+    n, F, B, L = 2048, 4, 16, 15
+    bins_T = jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) + 0.1)
+    args = (bins_T, grad, hess, jnp.ones(n, jnp.float32),
+            jnp.ones(F, bool), jnp.full(F, B, jnp.int32), jnp.zeros(F, bool))
+    params = TreeLearnerParams.from_config(
+        Config(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+    )
+    t1, _ = grow_tree(*args, params, num_bins=B, max_leaves=L)
+    grow = make_data_parallel_grower(
+        data_mesh(), num_bins=B, max_leaves=L,
+        growth="leafwise", sorted_hist=True,
+    )
+    t2, _ = grow(*args, params)
+    assert int(t1.num_leaves) == int(t2.num_leaves)
+    nl = int(t1.num_leaves)
+    same = sum(
+        int(np.asarray(t1.split_feature)[i]) == int(np.asarray(t2.split_feature)[i])
+        and int(np.asarray(t1.threshold_bin)[i]) == int(np.asarray(t2.threshold_bin)[i])
+        for i in range(nl - 1)
+    )
+    assert same >= nl - 2  # psum reduction-order ulps may flip one near-tie
