@@ -46,6 +46,9 @@ class Predictor:
     24-155): parse input rows, run normal/raw/leaf-index prediction,
     write one line per row (tab-separated for multi-output)."""
 
+    # inputs above this size stream through parse_file_chunks
+    stream_threshold = 1 << 28  # 256MB
+
     def __init__(self, booster, is_raw_score: bool, is_predict_leaf_index: bool):
         self.booster = booster
         self.is_raw_score = is_raw_score
@@ -53,21 +56,42 @@ class Predictor:
 
     def predict_file(self, data_path: str, result_path: str, has_header: bool = False,
                      num_iteration: int = -1) -> None:
-        out = self.booster.predict(
-            data_path,
-            num_iteration=num_iteration,
-            raw_score=self.is_raw_score,
-            pred_leaf=self.is_leaf,
-            data_has_header=has_header,
-        )
-        out = np.asarray(out)
-        with open(result_path, "w") as fh:
-            if out.ndim == 1:
-                for v in out:
-                    fh.write(f"{v:.9g}\n")
-            else:
-                for row in out:
-                    fh.write("\t".join(f"{v:.9g}" for v in row) + "\n")
+        # write through a temp file: a failing predict must not destroy
+        # an existing result file by truncating it up front
+        tmp_path = result_path + ".tmp"
+        with open(tmp_path, "w") as fh:
+            for out in self._predict_chunks(
+                data_path, has_header, num_iteration
+            ):
+                out = np.asarray(out)
+                if out.ndim == 1:
+                    for v in out:
+                        fh.write(f"{v:.9g}\n")
+                else:
+                    for row in out:
+                        fh.write("\t".join(f"{v:.9g}" for v in row) + "\n")
+        os.replace(tmp_path, result_path)
+
+    def _predict_chunks(self, data_path, has_header, num_iteration):
+        """Stream large CSV/TSV predict inputs chunk by chunk (the
+        reference's Predictor also streams, predictor.hpp:82); small or
+        LibSVM inputs take the one-shot path."""
+        from .io.parser import detect_file_format, parse_file_chunks
+
+        fmt = detect_file_format(data_path, has_header)
+        big = os.path.getsize(data_path) > self.stream_threshold
+        kw = dict(num_iteration=num_iteration, raw_score=self.is_raw_score,
+                  pred_leaf=self.is_leaf)
+        if fmt == "libsvm" or not big:
+            yield self.booster.predict(data_path, data_has_header=has_header,
+                                       **kw)
+            return
+        label_idx = self.booster._gbdt.label_idx
+        max_feat = self.booster._gbdt.max_feature_idx
+        for chunk in parse_file_chunks(data_path, has_header, fmt):
+            if chunk.shape[1] > max_feat + 1:
+                chunk = np.delete(chunk, label_idx, axis=1)
+            yield self.booster.predict(chunk, **kw)
 
 
 def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],

@@ -376,10 +376,9 @@ class BinnedDataset:
                 return BinnedDataset.load_binary(bin_path)
             except Exception:
                 pass
-        from .parser import _read_head, detect_format
+        from .parser import detect_file_format
 
-        head = _read_head(path, 3 if config.has_header else 2)
-        fmt = detect_format(head[1:] if config.has_header else head)
+        fmt = detect_file_format(path, config.has_header)
         if fmt == "libsvm" and not config.weight_column and not config.group_column:
             return BinnedDataset._from_libsvm_sparse(
                 path, config, reference=reference, rank=rank

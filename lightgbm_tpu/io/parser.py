@@ -32,6 +32,14 @@ def detect_format(sample_lines: List[str]) -> str:
     return "csv"
 
 
+def detect_file_format(path: str, has_header: bool = False) -> str:
+    """Sniff a file's format from its first data lines — the one public
+    entry point for the head-slicing convention shared by the loaders,
+    the chunked reader, and the CLI predictor."""
+    head = _read_head(path, 3 if has_header else 2)
+    return detect_format(head[1:] if has_header else head)
+
+
 def _read_head(path: str, n: int = 2) -> List[str]:
     lines = []
     with open(path, "r") as fh:

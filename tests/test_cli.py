@@ -153,3 +153,33 @@ def test_num_iteration_predict(tmp_path):
     from lightgbm_tpu.basic import Booster
     ref = Booster(model_file=model).predict(X, num_iteration=2)
     np.testing.assert_allclose(pl, ref, rtol=1e-5)
+
+
+def test_predict_file_streaming_matches_oneshot(tmp_path):
+    """Chunked file prediction (inputs over the stream threshold) writes
+    the same result file as the one-shot path."""
+    import numpy as np
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.cli import Predictor, main
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(800, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    data = str(tmp_path / "d.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    model = str(tmp_path / "m.txt")
+    assert main([
+        "task=train", f"data={data}", "objective=binary", "num_trees=3",
+        "num_leaves=7", f"output_model={model}", "is_save_binary_file=false",
+        "min_data_in_leaf=5",
+    ]) == 0
+    booster = Booster(model_file=model)
+    p = Predictor(booster, False, False)
+    one = str(tmp_path / "one.txt")
+    p.predict_file(data, one)
+    p.stream_threshold = 0  # force the chunked branch
+    streamed = str(tmp_path / "str.txt")
+    p.predict_file(data, streamed)
+    np.testing.assert_allclose(
+        np.loadtxt(one), np.loadtxt(streamed), rtol=1e-9
+    )

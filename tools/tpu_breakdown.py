@@ -1,0 +1,108 @@
+"""TPU performance breakdown: where one boosting iteration spends time.
+
+Run on a machine with the TPU attached (falls back to CPU with
+BENCH_PLATFORM=cpu).  Prints per-phase timings so kernel work can be
+told apart from host overhead — the evidence BASELINE.md's breakdown
+paragraph records:
+
+    python tools/tpu_breakdown.py [rows]
+
+Phases measured per growth mode (leafwise / depthwise):
+  - binning (host)
+  - first-tree compile
+  - steady-state s/tree over 10 trees
+  - raw histogram kernel throughput at the same shapes
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS = int(float(sys.argv[1])) if len(sys.argv) > 1 else 1_000_000
+
+
+def main():
+    import jax
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    import jax.numpy as jnp
+
+    import bench
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    print("devices:", jax.devices(), flush=True)
+    X, y = bench.make_data(ROWS)
+
+    results = {}
+    for growth in ("leafwise", "depthwise"):
+        cfg = Config(objective="binary", num_leaves=255, max_bin=255,
+                     learning_rate=0.1, min_data_in_leaf=100,
+                     metric=["auc"], tree_growth=growth)
+        t0 = time.perf_counter()
+        ds = BinnedDataset.from_matrix(
+            X, Metadata(label=y.astype(np.float32)), config=cfg)
+        t_bin = time.perf_counter() - t0
+        booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
+        t0 = time.perf_counter()
+        booster.train_one_iter()
+        _ = np.asarray(booster._scores[0, :1])
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        trees = 10
+        for _ in range(trees):
+            booster.train_one_iter()
+        _ = np.asarray(booster._scores)
+        t_tree = (time.perf_counter() - t0) / trees
+        auc = booster.eval_at(0).get("auc", float("nan"))
+        print(f"{growth}: bin {t_bin:.1f}s, compile+1st {t_compile:.1f}s, "
+              f"{t_tree*1000:.0f} ms/tree, AUC {auc:.4f}", flush=True)
+        results[growth] = t_tree
+
+    # raw kernel throughput at bench shapes
+    from lightgbm_tpu.ops.pallas_histogram import (
+        histogram_by_leaf_sorted, histogram_single_leaf)
+    from lightgbm_tpu.ops.histogram import histogram_by_leaf
+
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.RandomState(0)
+    F, B, L = 28, 255, 255
+    bins = jnp.asarray(rng.randint(0, B, (F, ROWS)).astype(np.uint8))
+    leaf = jnp.asarray(rng.randint(0, 128, ROWS).astype(np.int32))
+    g = jnp.asarray(rng.randn(ROWS).astype(np.float32))
+    ones = jnp.ones(ROWS, jnp.float32)
+
+    def t(fn, reps=5):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    ms = t(lambda: histogram_by_leaf_sorted(
+        bins, leaf, g, ones, ones, num_bins=B, num_leaves=L,
+        interpret=interpret)) * 1000
+    print(f"sorted level kernel (L=128 live): {ms:.1f} ms", flush=True)
+    ms = t(lambda: histogram_single_leaf(
+        bins[:, : ROWS // 4], g[: ROWS // 4], ones[: ROWS // 4],
+        ones[: ROWS // 4], num_bins=B, interpret=interpret)) * 1000
+    print(f"single-leaf kernel (n/4 rows): {ms:.1f} ms", flush=True)
+    if not interpret:
+        ms = t(lambda: histogram_by_leaf(
+            bins, leaf, g, ones, ones, num_bins=B, num_leaves=L), reps=2) * 1000
+        print(f"segment_sum level pass: {ms:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
