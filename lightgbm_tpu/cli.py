@@ -105,20 +105,29 @@ def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],
     sets.extend((i + 1, names[i]) for i in range(len(names)))
     for data_idx, name in sets:
         metrics = gbdt.train_metrics if data_idx == 0 else gbdt.valid_metrics[data_idx - 1]
-        scores = gbdt.predict_at(data_idx)
-        s = scores if gbdt.num_class > 1 else scores[0]
+        # device-resident eval where supported (scores stay in HBM); the
+        # host copy is pulled lazily, only if some metric needs it
+        plain = [m for m in metrics if not hasattr(m, "eval_multi")]
+        dev_vals = (
+            gbdt.eval_at(data_idx, only={m.name for m in plain})
+            if plain else {}
+        )
+        s = None
         for m in metrics:
             if hasattr(m, "eval_multi"):
                 # print every position, but early stopping judges a
                 # multi-position metric only by its LAST position, like
                 # the reference (gbdt.cpp OutputMetric: test_scores.back())
+                if s is None:
+                    scores = gbdt.predict_at(data_idx)
+                    s = scores if gbdt.num_class > 1 else scores[0]
                 values = m.eval_multi(s)
                 for k, v in zip(m.eval_at, values):
                     Log.info(f"Iteration: {iter_num}, {name} {m.name}@{k} : {v:g}")
                 if data_idx > 0 and len(values):
                     rows.append((data_idx, m.name, values[-1], m.bigger_is_better))
             else:
-                v = m.eval(s)
+                v = dev_vals[m.name]
                 Log.info(f"Iteration: {iter_num}, {name} {m.name} : {v:g}")
                 if data_idx > 0:
                     rows.append((data_idx, m.name, v, m.bigger_is_better))

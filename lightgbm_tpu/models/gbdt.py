@@ -179,7 +179,8 @@ class GBDT:
         mesh = data_mesh(num_devices=nd)
         if tl == "feature":
             return make_feature_parallel_grower(
-                mesh, num_bins=self._num_bins, max_leaves=self.max_leaves
+                mesh, num_bins=self._num_bins, max_leaves=self.max_leaves,
+                sorted_hist=self._use_pallas_hist(),
             )
         if tl == "voting":
             return make_voting_parallel_grower(
@@ -187,6 +188,7 @@ class GBDT:
                 num_bins=self._num_bins,
                 max_leaves=self.max_leaves,
                 top_k=self.config.top_k,
+                sorted_hist=self._use_pallas_hist(),
             )
         return make_data_parallel_grower(
             mesh,
@@ -215,9 +217,9 @@ class GBDT:
         The f64 reference-parity accumulation keeps segment_sum — the
         Pallas kernel is f32."""
         if self._use_pallas_hist():
-            from ..ops.pallas_histogram import make_single_hist_fn
+            from ..ops.histogram import select_single_hist_fn
 
-            return make_single_hist_fn(self._num_bins)
+            return select_single_hist_fn(self._num_bins, True)
         return None  # grower's default segment_sum path
 
     def _depthwise_hist_fn(self):
@@ -377,9 +379,11 @@ class GBDT:
         self._model_version += 1
 
     # ------------------------------------------------------------------- eval
-    def eval_at(self, data_idx: int) -> Dict[str, float]:
+    def eval_at(self, data_idx: int, only=None) -> Dict[str, float]:
         """Metric evaluation: data_idx 0 = train, 1.. = valid sets
-        (GBDT::GetPredictAt semantics, gbdt.cpp:388-426)."""
+        (GBDT::GetPredictAt semantics, gbdt.cpp:388-426).  ``only``
+        restricts to a set of metric names (callers that handle
+        multi-position metrics themselves skip them here)."""
         if data_idx == 0:
             scores, metrics = self._scores, self.train_metrics
         else:
@@ -388,6 +392,8 @@ class GBDT:
         dev = scores if self.num_class > 1 else scores[0]
         out: Dict[str, float] = {}
         host = None
+        if only is not None:
+            metrics = [m for m in metrics if m.name in only]
         for m in metrics:
             if m.eval_jax is not None:
                 # device path: scores stay in HBM, one scalar returns

@@ -67,3 +67,15 @@ def histogram_by_leaf(
 
     out = jax.vmap(per_feature)(keys)  # [F, L*B, 3]
     return out.reshape(bins_T.shape[0], num_leaves, num_bins, 3).transpose(1, 0, 2, 3)
+
+
+def select_single_hist_fn(num_bins: int, use_pallas: bool):
+    """ONE place choosing the per-row-set histogram implementation
+    (signature: bins_T, grad, hess, mask -> [F, B, 3]): the single-leaf
+    MXU kernel when requested, segment_sum otherwise.  Shared by the
+    serial learner wiring and every parallel maker."""
+    if use_pallas:
+        from .pallas_histogram import make_single_hist_fn
+
+        return make_single_hist_fn(num_bins)
+    return functools.partial(histogram_feature_major, num_bins=num_bins)

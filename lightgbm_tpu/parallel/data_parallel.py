@@ -47,22 +47,16 @@ def data_parallel_sharded(
     ``axis``).  Callers are responsible for row padding / global-array
     plumbing: use :func:`make_data_parallel_grower` single-host and
     multihost.make_multihost_data_parallel_grower across processes."""
-    if sorted_hist:
-        # MXU one-hot matmul kernels per shard (ops/pallas_histogram):
-        # the leaf-wise per-split histogram over the gathered smaller
-        # child, and the depthwise per-level leaf-sorted variant
-        from ..ops.pallas_histogram import (
-            make_single_hist_fn,
-            make_sorted_hist_fn,
-        )
+    from ..ops.histogram import select_single_hist_fn
 
-        hist_local = make_single_hist_fn(num_bins)
+    # per-shard kernels: leaf-wise per-split histogram over the gathered
+    # smaller child, and the depthwise per-level leaf-sorted variant
+    hist_local = select_single_hist_fn(num_bins, sorted_hist)
+    if sorted_hist:
+        from ..ops.pallas_histogram import make_sorted_hist_fn
+
         local_level_hist = make_sorted_hist_fn(num_bins)
     else:
-        hist_local = functools.partial(
-            histogram_feature_major, num_bins=num_bins
-        )
-
         def local_level_hist(bins_T, leaf_id, grad, hess, mask, num_leaves):
             return histogram_by_leaf(
                 bins_T, leaf_id, grad, hess, mask,

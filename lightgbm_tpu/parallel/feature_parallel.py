@@ -43,9 +43,13 @@ def combine_split_infos(r: SplitResult, axis: str) -> SplitResult:
     return SplitResult(*[f[winner] for f in g])
 
 
-def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int):
+def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int,
+                                 sorted_hist: bool = False):
     axis = mesh.axis_names[0]
     num_shards = mesh.shape[axis]
+    from ..ops.histogram import select_single_hist_fn
+
+    local_hist = select_single_hist_fn(num_bins, sorted_hist)
 
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
         F = bins_T.shape[0]
@@ -66,7 +70,7 @@ def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int):
             # full one): grow_tree may hand us a gathered smaller-child
             # row buffer whose row count differs from n.
             bp = jnp.pad(bins_arg, ((0, pad), (0, 0)))
-            return histogram_feature_major(local(bp), g, h, m, num_bins=num_bins)
+            return local_hist(local(bp), g, h, m)
 
         def search_fn(hist, sg, sh, c, can, _fm, _nb, _ic, prm):
             r = find_best_split(

@@ -31,10 +31,13 @@ from .mesh import ROW_AXIS, row_padded_grower
 
 
 def make_voting_parallel_grower(
-    mesh, num_bins: int, max_leaves: int, top_k: int, axis: str = ROW_AXIS
+    mesh, num_bins: int, max_leaves: int, top_k: int, axis: str = ROW_AXIS,
+    sorted_hist: bool = False,
 ):
     num_shards = mesh.shape[axis]
-    hist_local = functools.partial(histogram_feature_major, num_bins=num_bins)
+    from ..ops.histogram import select_single_hist_fn
+
+    hist_local = select_single_hist_fn(num_bins, sorted_hist)
 
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
         F = bins_T.shape[0]

@@ -97,3 +97,28 @@ def test_voting_parallel_restricted_topk_still_learns():
     grow_v = make_voting_parallel_grower(data_mesh(), num_bins=B, max_leaves=L, top_k=2)
     t_v, _ = grow_v(*args, _params())
     assert int(np.asarray(t_v.split_feature)[0]) == 17
+
+
+def test_feature_and_voting_parallel_matmul_hist():
+    """FP and voting learners with per-shard MXU histograms match their
+    segment_sum counterparts."""
+    B, L = 16, 7
+    args = _problem(1024, 8, B, seed=9)
+    params = TreeLearnerParams.from_config(
+        Config(min_data_in_leaf=10, min_sum_hessian_in_leaf=1e-3)
+    )
+    mesh = data_mesh()
+    for maker, kw in (
+        (make_feature_parallel_grower, {}),
+        (make_voting_parallel_grower, {"top_k": 3}),
+    ):
+        t_seg, _ = maker(mesh, num_bins=B, max_leaves=L, sorted_hist=False,
+                         **kw)(*args, params)
+        t_mm, _ = maker(mesh, num_bins=B, max_leaves=L, sorted_hist=True,
+                        **kw)(*args, params)
+        np.testing.assert_array_equal(
+            np.asarray(t_seg.split_feature), np.asarray(t_mm.split_feature)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(t_seg.threshold_bin), np.asarray(t_mm.threshold_bin)
+        )
