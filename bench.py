@@ -202,16 +202,18 @@ def ours_sec_per_tree(X, y) -> tuple[float, float, str]:
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
 
+    # leaf-wise is BOTH the reference-parity growth (trees match the
+    # reference binary; depthwise trades ~0.01 AUC, BASELINE.md) and the
+    # TPU-fast mode: each split's histogram is one-hot MXU matmuls over
+    # the gathered smaller child (histogram_single_leaf).  On the CPU
+    # fallback there is no MXU and per-split kernels serialize, so the
+    # level-synchronous mode is the honest default there.
+    default_growth = "leafwise" if platform == "tpu" else "depthwise"
     cfg = Config(
         objective="binary", num_leaves=NUM_LEAVES, max_bin=NUM_BINS,
         learning_rate=LEARNING_RATE, min_data_in_leaf=MIN_DATA,
         metric=["auc"],
-        # leaf-wise is BOTH the reference-parity growth (trees match the
-        # reference binary; depthwise trades ~0.01 AUC, BASELINE.md) and
-        # the TPU-fast mode: each split's histogram is one-hot MXU
-        # matmuls over the gathered smaller child
-        # (ops/pallas_histogram.histogram_single_leaf)
-        tree_growth=os.environ.get("BENCH_GROWTH", "leafwise"),
+        tree_growth=os.environ.get("BENCH_GROWTH", default_growth),
     )
     t0 = time.perf_counter()
     ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
