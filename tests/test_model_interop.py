@@ -1,0 +1,84 @@
+"""Model-file interop with the reference binary, BOTH directions.
+
+The text model format (gbdt.cpp:479-592, tree.cpp:124-151) is the
+contract that lets users move between the frameworks: models trained
+here must predict identically under the reference CLI, and
+reference-trained models must predict identically here (bench.py's
+baseline AUC already exercises the second direction; this pins both).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import bench
+
+
+@pytest.fixture(scope="module")
+def ref_exe():
+    exe = bench.build_reference_cli()
+    if exe is None:
+        pytest.skip("reference CLI unavailable")
+    return exe
+
+
+def _data(tmpdir, n=2000, f=8, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    path = os.path.join(tmpdir, "interop.csv")
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.8g", delimiter=",")
+    # reload the rounded values so BOTH frameworks predict the identical
+    # inputs — %.8g perturbs features by ~5e-9, enough to flip a sample
+    # across a midpoint threshold and produce a seed-dependent mismatch
+    X = np.loadtxt(path, delimiter=",")[:, 1:]
+    return X, y, path
+
+
+def test_reference_binary_predicts_our_model(ref_exe, tmp_path):
+    import lightgbm_tpu as lgb
+    import lightgbm_tpu.engine as engine
+
+    X, y, data = _data(str(tmp_path))
+    bst = engine.train(
+        {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 10},
+        lgb.Dataset(X, label=y), num_boost_round=10,
+    )
+    model = str(tmp_path / "ours.txt")
+    bst.save_model(model)
+    result = str(tmp_path / "ref_pred.txt")
+    r = subprocess.run(
+        [ref_exe, "task=prediction", f"data={data}",
+         f"input_model={model}", f"output_result={result}"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-500:] + r.stderr[-500:]
+    ref_pred = np.loadtxt(result)
+    np.testing.assert_allclose(bst.predict(X), ref_pred, atol=1e-7)
+
+
+def test_we_predict_reference_model(ref_exe, tmp_path):
+    from lightgbm_tpu.basic import Booster
+
+    X, y, data = _data(str(tmp_path), seed=6)
+    model = str(tmp_path / "theirs.txt")
+    r = subprocess.run(
+        [ref_exe, "task=train", f"data={data}", "objective=binary",
+         "num_trees=10", "num_leaves=15", "min_data_in_leaf=10",
+         f"output_model={model}", "is_save_binary_file=false"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-500:] + r.stderr[-500:]
+    result = str(tmp_path / "their_pred.txt")
+    r = subprocess.run(
+        [ref_exe, "task=prediction", f"data={data}",
+         f"input_model={model}", f"output_result={result}"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0
+    ref_pred = np.loadtxt(result)
+    ours = Booster(model_file=model).predict(X)
+    np.testing.assert_allclose(ours, ref_pred, atol=1e-7)
