@@ -168,6 +168,15 @@ def _init_backend() -> str:
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    try:  # persistent compile cache: repeated bench runs skip the 20-40s
+        # first-compile on the chip
+        os.makedirs(os.path.join(CACHE_DIR, "jaxcache"), exist_ok=True)
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(CACHE_DIR, "jaxcache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization, never a requirement
     def clear_backends():
         try:  # drop poisoned backend state before re-resolving
             from jax._src import xla_bridge

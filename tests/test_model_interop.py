@@ -78,7 +78,7 @@ def test_we_predict_reference_model(ref_exe, tmp_path):
          f"input_model={model}", f"output_result={result}"],
         capture_output=True, text=True, timeout=300,
     )
-    assert r.returncode == 0
+    assert r.returncode == 0, r.stdout[-500:] + r.stderr[-500:]
     ref_pred = np.loadtxt(result)
     ours = Booster(model_file=model).predict(X)
     np.testing.assert_allclose(ours, ref_pred, atol=1e-7)
