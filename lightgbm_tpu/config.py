@@ -168,6 +168,8 @@ class Config:
     bin_construct_sample_cnt: int = 50000
     is_pre_partition: bool = False
     is_enable_sparse: bool = True
+    # when false, ignore an existing <data>.bin cache (config.h:107)
+    enable_load_from_binary_file: bool = True
     use_two_round_loading: bool = False
     is_save_binary_file: bool = False
     is_predict_raw_score: bool = False

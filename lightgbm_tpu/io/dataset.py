@@ -294,7 +294,8 @@ class BinnedDataset:
         f_used = max(len(used_mappers), 1)
         density = sb.nnz / float(max(n, 1) * f_used)
         if keep_sparse is None:
-            keep_sparse = density < 0.2
+            # is_enable_sparse=false forces dense storage (config.h:104)
+            keep_sparse = config.is_enable_sparse and density < 0.2
         X_bin = sb if keep_sparse else sb.toarray()
         return BinnedDataset(
             X_bin, used_mappers, used_map, num_cols, metadata, feature_names
@@ -371,9 +372,18 @@ class BinnedDataset:
         ``jax.process_index()``."""
         config = config or Config()
         bin_path = path + ".bin"
-        if os.path.exists(bin_path) and reference is None and config.num_machines <= 1:
+        if (
+            config.enable_load_from_binary_file
+            and os.path.exists(bin_path)
+            and reference is None
+            and config.num_machines <= 1
+        ):
             try:
-                return BinnedDataset.load_binary(bin_path)
+                ds = BinnedDataset.load_binary(bin_path)
+                if ds.is_sparse and not config.is_enable_sparse:
+                    # the cache was written sparse; honor the flag anyway
+                    ds.X_bin = ds.dense_bins()
+                return ds
             except Exception:
                 pass
         from .parser import detect_file_format

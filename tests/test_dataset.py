@@ -105,3 +105,61 @@ def test_metadata_subset_remaps_queries():
     m = Metadata(label=np.zeros(10, np.float32), query_boundaries=np.array([0, 4, 7, 10]))
     sub = m.subset(np.array([0, 1, 5, 6, 8]))
     np.testing.assert_array_equal(sub.query_boundaries, [0, 2, 4, 5])
+
+
+def test_enable_load_from_binary_file_flag(tmp_path):
+    """enable_load_from_binary_file=false ignores an existing .bin cache
+    (config.h:107)."""
+    rng = np.random.RandomState(1)
+    p = str(tmp_path / "d.csv")
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    np.savetxt(p, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    ds = BinnedDataset.from_file(p, Config(is_save_binary_file=True))
+    assert (tmp_path / "d.csv.bin").exists()
+    # poison the cache: loading it would produce different labels
+    ds.metadata.label = ds.metadata.label + 100
+    ds.save_binary(p + ".bin")
+    cached = BinnedDataset.from_file(p, Config())
+    assert cached.metadata.label.max() > 50  # came from the cache
+    fresh = BinnedDataset.from_file(
+        p, Config(enable_load_from_binary_file=False, is_save_binary_file=False)
+    )
+    assert fresh.metadata.label.max() <= 1  # re-parsed the text file
+
+
+def test_is_enable_sparse_false_forces_dense():
+    rng = np.random.RandomState(2)
+    dense = np.where(rng.rand(300, 30) < 0.05, rng.randn(300, 30), 0.0)
+    rows, cols = np.nonzero(dense)
+    row_lens = np.bincount(rows, minlength=300)
+    indptr = np.concatenate([[0], np.cumsum(row_lens)]).astype(np.int64)
+    y = np.zeros(300, np.float32)
+    sparse = BinnedDataset.from_csr(
+        indptr, cols.astype(np.int64), dense[rows, cols], 30,
+        Metadata(label=y), Config(max_bin=16)
+    )
+    assert sparse.is_sparse
+    forced = BinnedDataset.from_csr(
+        indptr, cols.astype(np.int64), dense[rows, cols], 30,
+        Metadata(label=y), Config(max_bin=16, is_enable_sparse=False)
+    )
+    assert not forced.is_sparse
+    np.testing.assert_array_equal(forced.X_bin, sparse.dense_bins())
+
+
+def test_sparse_cache_densified_when_sparse_disabled(tmp_path):
+    """A .bin cache written with sparse storage still honors
+    is_enable_sparse=false on reload."""
+    rng = np.random.RandomState(3)
+    p = str(tmp_path / "s.libsvm")
+    with open(p, "w") as fh:
+        for i in range(200):
+            cols = np.sort(rng.choice(40, size=3, replace=False))
+            pairs = " ".join(f"{j}:{rng.randn():.4g}" for j in cols)
+            fh.write(f"{i % 2} {pairs}\n")
+    ds = BinnedDataset.from_file(p, Config(is_save_binary_file=True))
+    assert ds.is_sparse and os.path.exists(p + ".bin")
+    cached = BinnedDataset.from_file(p, Config(is_enable_sparse=False))
+    assert not cached.is_sparse
+    np.testing.assert_array_equal(cached.X_bin, ds.dense_bins())
