@@ -6,17 +6,24 @@ growth — repeatedly split the leaf with the globally best gain until the
 ``num_leaves`` budget or no positive gain remains — expressed as a
 fixed-shape ``lax.fori_loop``:
 
-* per split step, only the SMALLER child's histogram is built from data
-  (one masked scatter pass over all rows); the larger child is parent -
-  smaller (the Subtract trick, feature_histogram.hpp:97-106 and
-  serial_tree_learner.cpp:259-281).  Histograms for every live leaf stay
+* the row partition is a PERSISTENT leaf-sorted permutation ``order``
+  plus per-leaf ``(begin, count)`` ranges — the reference's
+  DataPartition (data_partition.hpp:91-139) re-cast for static shapes.
+  Each split touches only the parent leaf's contiguous range via
+  capacity-tiered ``dynamic_slice`` (a ``lax.cond`` chain picks the
+  smallest static capacity that fits), so per-split work is
+  O(|parent|), not O(n): the whole tree costs O(n * depth) partition
+  work like the reference, instead of O(n * num_leaves).
+* per split, only the SMALLER child's histogram is built from data —
+  its rows are one contiguous ``dynamic_slice`` of ``order`` (the
+  ordered-gradients gather, serial_tree_learner.cpp:259-315); the
+  larger child is parent - smaller (the Subtract trick,
+  feature_histogram.hpp:97-106).  Histograms for every live leaf stay
   resident in HBM (``hists[L, F, B, 3]``) — the LRU HistogramPool
   (feature_histogram.hpp:337-481) is unnecessary at TPU memory sizes.
-* the leaf partition is an int32 ``leaf_id`` row vector updated by a
-  vectorized compare (replaces DataPartition::Split, data_partition.hpp:91).
-  Left child keeps the parent's leaf index, right child gets the next
-  fresh index — the reference's exact leaf numbering (tree.cpp:78-89),
-  so trees are comparable node-for-node.
+* leaf numbering matches the reference exactly (left child keeps the
+  parent's leaf index, right child gets the next fresh index,
+  tree.cpp:78-89), so trees are comparable node-for-node.
 * every store in the split step is MASKED on the split-fired predicate
   (rather than branching with ``lax.cond``, whose pass-through branch
   forced XLA to copy the histogram buffer each iteration), so all state
@@ -24,7 +31,7 @@ fixed-shape ``lax.fori_loop``:
   remaining steps.
 
 The data-parallel learner wraps this same step with psum'd histograms
-(learners/data_parallel.py); determinism of argmax tie-breaks keeps
+(parallel/data_parallel.py); determinism of argmax tie-breaks keeps
 parallel == serial trees (split_info.hpp:98-103 semantics).
 """
 
@@ -64,7 +71,9 @@ class TreeLearnerParams(NamedTuple):
 
 
 class _GrowState(NamedTuple):
-    leaf_id: jax.Array  # [n]
+    order: jax.Array  # [n + max_cap] leaf-sorted row permutation (pad = n)
+    leaf_begin: jax.Array  # [L] int32 range start per leaf (order-space)
+    pos_cnt: jax.Array  # [L] int32 positional count per leaf (incl. OOB rows)
     hists: jax.Array  # [L, F, B, 3]
     sum_g: jax.Array  # [L]
     sum_h: jax.Array  # [L]
@@ -94,66 +103,85 @@ def _set_best(best: SplitResult, i, new: SplitResult) -> SplitResult:
     return SplitResult(*[b.at[i].set(n) for b, n in zip(best, new)])
 
 
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
 def _hist_tiers(n: int):
-    """Static gather capacities for the smaller-child histogram: a few
-    fractions of n, rounded up to lanes, deduped, smallest-first use."""
-    caps = []
-    for frac in (4, 8, 16, 32, 64, 128, 256):
-        cap = max(512, ((-(-n // frac) + 127) // 128) * 128)
-        if cap < n and cap not in caps:
-            caps.append(cap)
-    return tuple(caps)
+    """Static slice capacities for the smaller-child histogram: power-of
+    -two fractions of n, lane-aligned, ascending.  Includes a full-n
+    tier: under row sharding the LOCAL count of the globally-smaller
+    child can approach n_local (global balance says nothing about one
+    shard's split), so ceil(n/2) is not a guaranteed fit there."""
+    caps = {max(512, _round_up(n, 128))}
+    for frac in (256, 128, 64, 32, 16, 8, 4, 2):
+        caps.add(max(512, _round_up(-(-n // frac), 128)))
+    return tuple(sorted(caps))
 
 
-def _gathered_hist(hist_fn, bins_T, grad, hess, in_small, cap: int):
-    """Gather the rows where ``in_small`` into a [cap]-row buffer (order
-    preserved via cumsum positions — one O(n) pass, no sort) and run the
-    histogram kernel over the buffer only."""
-    n = grad.shape[0]
-    pos = jnp.cumsum(in_small.astype(jnp.int32)) - 1
-    # rows beyond cap (excluded by the exact-count tier gate; the guard
-    # is belt-and-braces) and rows outside the child land in the dump slot
-    dest = jnp.where(in_small & (pos < cap), pos, cap)
-    idx = (
-        jnp.full(cap + 1, n, jnp.int32)
-        .at[dest]
-        .set(jnp.arange(n, dtype=jnp.int32))[:cap]
-    )
-    valid = idx < n
-    idxc = jnp.minimum(idx, n - 1)
-    return hist_fn(
-        jnp.take(bins_T, idxc, axis=1),
-        grad[idxc],
-        hess[idxc],
-        valid.astype(grad.dtype),
-    )
+def _part_tiers(n: int):
+    """Capacities for the parent-range partition slice (the root split
+    spans every row; _hist_tiers already tops out at full n)."""
+    return _hist_tiers(n)
 
 
-def _smaller_child_hist(hist_fn, bins_T, grad, hess, in_small, cnt_small, tiers):
-    """Histogram of the smaller child without touching all rows — the
-    reference's ordered-gradients trick (serial_tree_learner.cpp:259-315)
-    re-cast for static shapes: pick the smallest capacity tier that fits
-    the child (lax.cond chain) and gather its rows there; fall back to
-    the full masked pass for large children.  Cuts the per-split
-    histogram work from O(n * F) to O(|smaller child| * F)."""
-
-    def full(_):
-        return hist_fn(bins_T, grad, hess, in_small.astype(grad.dtype))
-
-    fn = full
-    for cap in sorted(tiers, reverse=True):
+def _tier_chain(caps, gate_cnt, branch_fn):
+    """Run ``branch_fn(cap)`` for the smallest static cap >= gate_cnt.
+    ``caps`` must be ascending with its largest entry a guaranteed fit."""
+    fn = lambda _: branch_fn(caps[-1])  # noqa: E731 — guaranteed fallback
+    for cap in sorted(caps[:-1], reverse=True):
         def tiered(_, cap=cap, nxt=fn):
             return jax.lax.cond(
-                cnt_small <= cap,
-                lambda __: _gathered_hist(
-                    hist_fn, bins_T, grad, hess, in_small, cap
-                ),
-                nxt,
-                None,
+                gate_cnt <= cap, lambda __: branch_fn(cap), nxt, None
             )
 
         fn = tiered
     return fn(None)
+
+
+def _partition_branch(order, bins_T, f, thr, is_cat, begin, pcnt, do_split, cap):
+    """Stably partition the parent's [begin, begin+pcnt) range of
+    ``order`` by the split decision (DataPartition::Split,
+    data_partition.hpp:91-139): left-going rows keep their relative
+    order at the front, right-going rows follow.  Positions past pcnt
+    (other leaves' rows inside the static cap window) are written back
+    unchanged.  Returns (order, nleft)."""
+    n = bins_T.shape[1]
+    rows_p = jax.lax.dynamic_slice(order, (begin,), (cap,))
+    validp = jnp.arange(cap, dtype=jnp.int32) < pcnt
+    rows_c = jnp.minimum(rows_p, n - 1)
+    frow = jax.lax.dynamic_index_in_dim(bins_T, f, axis=0, keepdims=False)
+    vals = frow[rows_c].astype(jnp.int32)
+    go = jnp.where(is_cat, vals == thr, vals <= thr) & validp
+    nleft = jnp.sum(go.astype(jnp.int32))
+    lpos = jnp.cumsum(go.astype(jnp.int32)) - 1
+    rpos = nleft + jnp.cumsum((validp & ~go).astype(jnp.int32)) - 1
+    # invalid positions get DISTINCT out-of-bounds indices (cap + j):
+    # unique_indices promises every index distinct, and mode="drop"
+    # discards all of them
+    newpos = jnp.where(
+        go,
+        lpos,
+        jnp.where(validp, rpos, cap + jnp.arange(cap, dtype=jnp.int32)),
+    )
+    buf = rows_p.at[newpos].set(rows_p, mode="drop", unique_indices=True)
+    out = jnp.where(do_split, buf, rows_p)
+    return jax.lax.dynamic_update_slice(order, out, (begin,)), nleft
+
+
+def _child_hist_branch(hist_fn, order, bins_T, grad, hess, bag_mask,
+                       begin_s, cnt_s, cap):
+    """Histogram of one child from its contiguous ``order`` range: slice
+    the row ids, gather bins/grad/hess, mask rows past cnt_s and
+    unbagged rows, and run the histogram kernel over the capped buffer
+    only (the ordered-gradients gather, serial_tree_learner.cpp:283-315)."""
+    n = grad.shape[0]
+    rows = jax.lax.dynamic_slice(order, (begin_s,), (cap,))
+    valid = jnp.arange(cap, dtype=jnp.int32) < cnt_s
+    rows_c = jnp.minimum(rows, n - 1)
+    sub = jnp.take(bins_T, rows_c, axis=1)
+    m = valid.astype(grad.dtype) * bag_mask[rows_c]
+    return hist_fn(sub, grad[rows_c], hess[rows_c], m)
 
 
 def default_search_fn(
@@ -182,7 +210,10 @@ def default_search_fn(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn"),
+    static_argnames=(
+        "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
+        "reduce_max_fn",
+    ),
 )
 def grow_tree(
     bins_T: jax.Array,  # [F, n] feature-major binned matrix
@@ -198,6 +229,7 @@ def grow_tree(
     hist_fn=None,
     reduce_fn=None,
     search_fn=None,
+    reduce_max_fn=None,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -206,16 +238,22 @@ def grow_tree(
     default is the local kernel.  ``reduce_fn`` (cross-device sum) is
     applied to the root (Σg, Σh, count) scalars — the analog of the
     data-parallel learner's tree-start allreduce
-    (data_parallel_tree_learner.cpp:97-125).
+    (data_parallel_tree_learner.cpp:97-125).  ``reduce_max_fn``
+    (cross-device max) makes the static-capacity tier gates uniform
+    across row shards whose local leaf sizes differ; both default to
+    local values on a single device.
     """
     F, n = bins_T.shape
     L = max_leaves
-    tiers = _hist_tiers(n)
+    h_tiers = _hist_tiers(n)
+    p_tiers = _part_tiers(n)
+    order_pad = max(p_tiers + h_tiers)
 
     if hist_fn is None:
         hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
     if search_fn is None:
         search_fn = default_search_fn
+    gate = (lambda c: c) if reduce_max_fn is None else reduce_max_fn
 
     def best_for(hist, sg, sh, c, depth_child):
         can = (params.max_depth <= 0) | (depth_child < params.max_depth)
@@ -238,7 +276,14 @@ def grow_tree(
     # (include/LightGBM/bin.h:21-22)
     acc_dt = hist0.dtype
     state = _GrowState(
-        leaf_id=jnp.zeros(n, jnp.int32),
+        order=jnp.concatenate(
+            [
+                jnp.arange(n, dtype=jnp.int32),
+                jnp.full(order_pad, n, jnp.int32),
+            ]
+        ),
+        leaf_begin=jnp.zeros(L, jnp.int32),
+        pos_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
         hists=jnp.zeros((L,) + hist0.shape, acc_dt).at[0].set(hist0),
         sum_g=jnp.zeros(L, acc_dt).at[0].set(sum_g0),
         sum_h=jnp.zeros(L, acc_dt).at[0].set(sum_h0),
@@ -265,14 +310,27 @@ def grow_tree(
 
         f = state.best.feature[best_leaf]
         thr = state.best.threshold[best_leaf]
-        is_cat = is_categorical[f]
+        is_cat = is_categorical[jnp.maximum(f, 0)]
 
-        # ---- partition (DataPartition::Split, data_partition.hpp:91-139)
-        vals = bins_T[f].astype(jnp.int32)
-        go_left = jnp.where(is_cat, vals == thr, vals <= thr)
-        in_leaf = state.leaf_id == best_leaf
-        leaf_id = jnp.where(
-            do_split & in_leaf & ~go_left, new_leaf, state.leaf_id
+        # ---- partition the parent's range in place (DataPartition::Split)
+        begin = state.leaf_begin[best_leaf]
+        pcnt = state.pos_cnt[best_leaf]
+        order, nleft = _tier_chain(
+            p_tiers,
+            gate(pcnt),
+            lambda cap: _partition_branch(
+                state.order, bins_T, f, thr, is_cat, begin, pcnt, do_split, cap
+            ),
+        )
+        nright = pcnt - nleft
+        leaf_begin = state.leaf_begin.at[new_leaf].set(
+            jnp.where(do_split, begin + nleft, state.leaf_begin[new_leaf])
+        )
+        pos_cnt = (
+            state.pos_cnt.at[best_leaf]
+            .set(jnp.where(do_split, nleft, pcnt))
+            .at[new_leaf]
+            .set(jnp.where(do_split, nright, state.pos_cnt[new_leaf]))
         )
 
         lsg = state.best.left_sum_grad[best_leaf]
@@ -282,20 +340,25 @@ def grow_tree(
         rsh = state.best.right_sum_hess[best_leaf]
         rc = state.best.right_count[best_leaf]
 
-        # ---- smaller-child histogram from data; sibling by subtraction.
-        # The tier gate needs an EXACT count (the f32 histogram count
-        # channel undercounts past 2^24 rows) that is also identical on
-        # every shard (the tier branches may contain collectives): an
-        # int32 sum of the local membership mask, allreduced when the
-        # rows are sharded.
-        smaller_is_left = lc <= rc
-        target = jnp.where(smaller_is_left, best_leaf, new_leaf)
-        in_small = (leaf_id == target) & (bag_mask > 0)
-        cnt_small = jnp.sum(in_small.astype(jnp.int32))
+        # ---- smaller-child histogram from its contiguous range; sibling
+        # by subtraction.  "Smaller" is by POSITIONAL count (the work the
+        # gather actually does) — reduced across row shards: every shard
+        # must pick the SAME child (the psum inside the hist branch sums
+        # one child's partials), even though local counts differ.  The
+        # tier gate must likewise be uniform, hence gate() (pmax).
+        nleft_g, nright_g = nleft, nright
         if reduce_fn is not None:
-            cnt_small = reduce_fn(cnt_small)
-        h_small = _smaller_child_hist(
-            hist_fn, bins_T, grad, hess, in_small, cnt_small, tiers
+            nleft_g, nright_g = reduce_fn(nleft), reduce_fn(nright)
+        small_is_left = nleft_g <= nright_g
+        cnt_s = jnp.where(small_is_left, nleft, nright)
+        begin_s = jnp.where(small_is_left, begin, begin + nleft)
+        h_small = _tier_chain(
+            h_tiers,
+            gate(cnt_s),
+            lambda cap: _child_hist_branch(
+                hist_fn, order, bins_T, grad, hess, bag_mask,
+                begin_s, cnt_s, cap,
+            ),
         )
         # read the two slots BEFORE the in-place updates, behind a
         # barrier so the reads can't fuse into the update computation —
@@ -304,8 +367,8 @@ def grow_tree(
             (state.hists[best_leaf], state.hists[new_leaf])
         )
         h_large = h_parent - h_small
-        h_left = jnp.where(smaller_is_left, h_small, h_large)
-        h_right = jnp.where(smaller_is_left, h_large, h_small)
+        h_left = jnp.where(small_is_left, h_small, h_large)
+        h_right = jnp.where(small_is_left, h_large, h_small)
         # materialize once: the buffer update below and the child split
         # searches must consume the SAME tensors — if the searches re-read
         # slices of the pre-update buffer, it has to outlive the update
@@ -380,7 +443,9 @@ def grow_tree(
         best = _set_best(_set_best(state.best, best_leaf, best_l), new_leaf, best_r)
 
         return _GrowState(
-            leaf_id=leaf_id,
+            order=order,
+            leaf_begin=leaf_begin,
+            pos_cnt=pos_cnt,
             hists=hists,
             sum_g=m(m(state.sum_g, best_leaf, lsg), new_leaf, rsg),
             sum_h=m(m(state.sum_h, best_leaf, lsh), new_leaf, rsh),
@@ -395,4 +460,22 @@ def grow_tree(
         return split_branch(state, jnp.int32(step), best_leaf, do_split)
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
-    return state.tree, state.leaf_id
+
+    # ---- per-row leaf assignment from the final ranges: leaves own
+    # disjoint contiguous [begin, begin+count) spans of ``order``, so the
+    # leaf of a position is a searchsorted over the (few) sorted begins,
+    # then one unique-index scatter maps positions back to rows.
+    tree = state.tree
+    idxL = jnp.arange(L, dtype=jnp.int32)
+    valid_leaf = (idxL < tree.num_leaves) & (state.pos_cnt > 0)
+    key = jnp.where(valid_leaf, state.leaf_begin, jnp.int32(n + order_pad))
+    perm = jnp.argsort(key).astype(jnp.int32)
+    sb = key[perm]
+    leaf_of_pos = perm[
+        jnp.searchsorted(sb, jnp.arange(n, dtype=jnp.int32), side="right") - 1
+    ]
+    rows = jnp.minimum(state.order[:n], n - 1)
+    leaf_id = (
+        jnp.zeros(n, jnp.int32).at[rows].set(leaf_of_pos, unique_indices=True)
+    )
+    return tree, leaf_id

@@ -75,6 +75,12 @@ def data_parallel_sharded(
     def reduce_sum(x):
         return jax.lax.psum(x, axis)
 
+    def reduce_max(x):
+        # tier-gate uniformity: local leaf sizes differ per row shard, but
+        # the static slice capacity (a lax.cond branch containing psums)
+        # must be chosen identically everywhere
+        return jax.lax.pmax(x, axis)
+
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
         if growth == "depthwise":
             return grow_tree_depthwise(
@@ -95,6 +101,7 @@ def data_parallel_sharded(
             max_leaves=max_leaves,
             hist_fn=hist_psum,
             reduce_fn=reduce_sum,
+            reduce_max_fn=reduce_max,
         )
 
     return jax.shard_map(

@@ -89,6 +89,7 @@ def make_voting_parallel_grower(
             hist_fn=hist_local,
             reduce_fn=lambda x: jax.lax.psum(x, axis),
             search_fn=search_fn,
+            reduce_max_fn=lambda x: jax.lax.pmax(x, axis),
         )
 
     sharded = jax.shard_map(
