@@ -150,6 +150,16 @@ def _model_train_auc(model_path: str, X, y) -> float:
 
 
 # --------------------------------------------------------------------- ours
+def _default_backend_alive(timeout_s: float = 240.0) -> bool:
+    """A dead TPU tunnel makes ``jax.devices()`` HANG rather than raise —
+    and a hang inside the bench process means no JSON line at all, which
+    the retry/fallback in _init_backend cannot save.  Probe in a
+    throwaway subprocess instead (shared helper)."""
+    from lightgbm_tpu.backend import default_backend_alive
+
+    return default_backend_alive(timeout_s, log=log)
+
+
 def _init_backend() -> str:
     """Initialize a JAX backend without dying: prefer the default (the
     TPU chip under the driver), retry once on transient init failure,
@@ -168,6 +178,9 @@ def _init_backend() -> str:
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    elif not _default_backend_alive():
+        log("default backend unresponsive (dead TPU tunnel?); pinning CPU")
+        jax.config.update("jax_platforms", "cpu")
     try:  # persistent compile cache: repeated bench runs skip the 20-40s
         # first-compile on the chip
         os.makedirs(os.path.join(CACHE_DIR, "jaxcache"), exist_ok=True)

@@ -153,7 +153,10 @@ def _partition_branch(order, bins_T, f, thr, is_cat, begin, pcnt, do_split, cap)
     frow = jax.lax.dynamic_index_in_dim(bins_T, f, axis=0, keepdims=False)
     vals = frow[rows_c].astype(jnp.int32)
     go = jnp.where(is_cat, vals == thr, vals <= thr) & validp
-    nleft = jnp.sum(go.astype(jnp.int32))
+    # dtype pinned: under jax_enable_x64 (hist_dtype=float64) a plain sum
+    # promotes to int64 and the int32 leaf_begin/pos_cnt scatters become
+    # unsafe casts
+    nleft = jnp.sum(go, dtype=jnp.int32)
     lpos = jnp.cumsum(go.astype(jnp.int32)) - 1
     rpos = nleft + jnp.cumsum((validp & ~go).astype(jnp.int32)) - 1
     # invalid positions get DISTINCT out-of-bounds indices (cap + j):
