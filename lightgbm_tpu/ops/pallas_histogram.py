@@ -10,19 +10,33 @@ This module reformulates the histogram as dense MXU work:
    as the reference's DataPartition, data_partition.hpp:91-139), with
    each leaf padded to a multiple of the chunk size C so that
 2. every C-row chunk belongs to exactly ONE leaf, and its histogram is a
-   one-hot matmul: ``onehot(bins)[C, B]^T @ stats[C, 4] -> [B, 4]`` on
-   the MXU — no scatter at all, and
+   one-hot matmul on the MXU — no scatter at all, and
 3. chunks of the same leaf are consecutive in the grid, so the Pallas
    output block (indexed by a scalar-prefetched ``leaf_of_chunk`` map)
    stays resident in VMEM and accumulates across chunk visits.
 
 Total work is O(n x F x B) MACs per tree LEVEL — independent of the
 number of leaves — plus one stable sort of the leaf ids.
+
+Two kernel variants (LGBM_TPU_HIST_KERNEL env selects; default "v1"
+until bsub has real-chip timings; pass ``variant=`` explicitly when
+benchmarking — the env var is only read at TRACE time, so flipping it
+between calls of identical shapes hits the jit cache and is ignored):
+
+* ``bsub`` — the one-hot is built TRANSPOSED (``[B, C]``) by comparing a
+  ``[1, C]`` feature row against a SUBLANE iota, then
+  ``onehot[B, C] @ stats[C, 4] -> [B, 4]``.  The feature row stays in
+  the lane dimension end to end — no relayout.
+* ``v1`` — the historical form: each feature row is reshaped to
+  ``[C, 1]`` (a lane->sublane relayout, one per feature per chunk —
+  measured to dominate kernel time) and ``stats^T[4, C] @ onehot[C, B]
+  -> [4, B]``.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -31,19 +45,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_CHUNK = 1024
 FGROUP = 8  # feature rows per kernel loop step (int8 sublane-pack aligned)
+# bsub feature-group block height: the [C, 4] stats block is re-fetched
+# once per (feature-group, chunk) grid step, so wider groups amortize
+# that HBM traffic; 16 keeps the (1, FG, B, 4) accumulator block at
+# 16 x 256 x 128 lanes x 4B = 8.4MB of VMEM
+FGROUP_BSUB = 16
+_VARIANTS = ("v1", "bsub")
 
 
-def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, chunk):
+def _kernel_variant(variant: str | None = None) -> str:
+    # default stays on the chip-proven v1 until bsub has a real Mosaic
+    # compile + timing on TPU hardware (tunnel down at authoring time)
+    v = variant or os.environ.get("LGBM_TPU_HIST_KERNEL", "v1")
+    if v not in _VARIANTS:
+        raise ValueError(
+            f"unknown histogram kernel variant {v!r}; expected one of {_VARIANTS}"
+        )
+    return v
+
+
+def _hist_kernel_v1(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, chunk):
     """One grid step = one C-row chunk of a single leaf.
 
     bins_ref:  [F, C] uint8 (this chunk's bins, feature-major)
     stats_ref: [C, 4] f32   (g*m, h*m, m, 0)
     out_ref:   [1, F, 4, B] f32 block at row ``leaf_of_chunk[c]`` —
                revisited (and therefore VMEM-resident) across all chunks
-               of the same leaf.  The stats axis sits in the SUBLANE dim
-               (padded 4->8) and the bin axis in the LANE dim: the
-               [4, C] x [C, B] matmul then wastes only 2x of the MXU,
-               where the transposed form would pad 4 lanes to 128 (32x).
+               of the same leaf.
     """
     c = pl.program_id(0)
     prev = leaf_of_chunk[jnp.maximum(c - 1, 0)]
@@ -79,6 +107,45 @@ def _hist_kernel(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_f, num_b, c
     jax.lax.fori_loop(0, num_groups, group_body, 0)
 
 
+def _hist_kernel_bsub(leaf_of_chunk, bins_ref, stats_ref, out_ref, *, num_b, chunk):
+    """Relayout-free variant: one grid step = one C-row chunk of one leaf
+    x one FGROUP-wide feature group (grid (F_groups, n_chunks), chunk
+    MINOR so the accumulation block stays VMEM-resident across a leaf's
+    chunks).
+
+    bins_ref:  [FGROUP_BSUB, C] uint8 (feature-major; C in LANES)
+    stats_ref: [C, 4] f32
+    out_ref:   [1, FGROUP_BSUB, B, 4] f32 block at (leaf_of_chunk[c], fg) —
+               bounded VMEM whatever the full feature count is (the
+               minor 4 pads to 128 lanes, so a full-F block would be
+               F x B x 128 floats).
+
+    The [1, C] feature row broadcasts across SUBLANES against a [B, C]
+    sublane iota, so the one-hot is born transposed and the row never
+    leaves the lane dimension; ``onehot[B, C] @ stats[C, 4]`` contracts
+    the shared lane axis on the MXU.
+    """
+    c = pl.program_id(1)
+    prev = leaf_of_chunk[jnp.maximum(c - 1, 0)]
+    is_first = (c == 0) | (leaf_of_chunk[c] != prev)
+
+    @pl.when(is_first)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    stats = stats_ref[...]  # [C, 4]
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (num_b, chunk), 0)
+    blk = bins_ref[...].astype(jnp.int32)  # [FGROUP_BSUB, C]
+    for i in range(FGROUP_BSUB):
+        row = blk[i : i + 1, :]  # [1, C] — stays in lanes
+        onehot = (row == iota_s).astype(jnp.float32)  # [B, C]
+        contrib = jax.lax.dot_general(
+            onehot, stats, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, 4]
+        out_ref[0, i] = out_ref[0, i] + contrib
+
+
 def _pad_pow(b: int) -> int:
     """Bin axis padded up to a lane multiple (128).  Must never round
     DOWN: max_bin > 256 is legal (uint16 bins), and a capped pad would
@@ -88,34 +155,61 @@ def _pad_pow(b: int) -> int:
 
 def _hist_pallas_call(
     leaf_of_chunk, bins_buf, stats_buf, out_leaves, Fp, B, C, n_chunks,
-    interpret,
+    interpret, variant=None,
 ):
-    """Shared pallas_call scaffolding for both histogram kernels: one
-    grid step per C-row chunk, output block (1, Fp, 4, B) indexed by the
-    scalar-prefetched chunk->leaf map."""
-    kernel = functools.partial(_hist_kernel, num_f=Fp, num_b=B, chunk=C)
+    """Shared pallas_call scaffolding for both kernels: one grid step per
+    C-row chunk, output block indexed by the scalar-prefetched
+    chunk->leaf map.  Returns hist[out_leaves, Fp, B, 4] in the
+    CANONICAL bin-major layout whichever kernel variant ran."""
+    if _kernel_variant(variant) == "v1":
+        kernel = functools.partial(_hist_kernel_v1, num_f=Fp, num_b=B, chunk=C)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((Fp, C), lambda c, leaf_ref: (0, c)),
+                pl.BlockSpec((C, 4), lambda c, leaf_ref: (c, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, Fp, 4, B), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
+            ),
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((out_leaves, Fp, 4, B), jnp.float32),
+            interpret=interpret,
+        )(leaf_of_chunk, bins_buf, stats_buf)
+        return out.transpose(0, 1, 3, 2)  # -> [L, Fp, B, 4]
+
+    # bsub: feature groups ride the OUTER grid axis (chunk minor), so the
+    # (leaf, fg) accumulation block stays VMEM-resident across a leaf's
+    # consecutive chunks and VMEM is bounded at FGROUP_BSUB x B x 128
+    # floats regardless of the feature count
+    kernel = functools.partial(_hist_kernel_bsub, num_b=B, chunk=C)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n_chunks,),
+        grid=(Fp // FGROUP_BSUB, n_chunks),
         in_specs=[
-            pl.BlockSpec((Fp, C), lambda c, leaf_ref: (0, c)),
-            pl.BlockSpec((C, 4), lambda c, leaf_ref: (c, 0)),
+            pl.BlockSpec((FGROUP_BSUB, C), lambda fg, c, leaf_ref: (fg, c)),
+            pl.BlockSpec((C, 4), lambda fg, c, leaf_ref: (c, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, Fp, 4, B), lambda c, leaf_ref: (leaf_ref[c], 0, 0, 0)
+            (1, FGROUP_BSUB, B, 4),
+            lambda fg, c, leaf_ref: (leaf_ref[c], fg, 0, 0),
         ),
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((out_leaves, Fp, 4, B), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((out_leaves, Fp, B, 4), jnp.float32),
         interpret=interpret,
     )(leaf_of_chunk, bins_buf, stats_buf)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "num_leaves", "chunk", "interpret"),
+    static_argnames=("num_bins", "num_leaves", "chunk", "interpret", "variant"),
 )
 def histogram_by_leaf_sorted(
     bins_T: jax.Array,  # [F, n] uint8/uint16 binned matrix, feature-major
@@ -127,6 +221,7 @@ def histogram_by_leaf_sorted(
     num_leaves: int,
     chunk: int = DEFAULT_CHUNK,
     interpret: bool = False,
+    variant: str | None = None,
 ) -> jax.Array:
     """Drop-in equivalent of ops.histogram.histogram_by_leaf:
     returns hist[num_leaves, F, num_bins, 3] = (sum_grad, sum_hess, count).
@@ -135,7 +230,7 @@ def histogram_by_leaf_sorted(
     L = num_leaves
     C = chunk
     B = _pad_pow(num_bins)
-    Fp = ((F + FGROUP - 1) // FGROUP) * FGROUP  # kernel walks FGROUP rows/step
+    Fp = ((F + FGROUP_BSUB - 1) // FGROUP_BSUB) * FGROUP_BSUB  # fits both groupings
     if Fp != F:
         bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
 
@@ -182,14 +277,13 @@ def histogram_by_leaf_sorted(
 
     out = _hist_pallas_call(
         leaf_of_chunk, bins_buf, stats_buf, L + 1, Fp, B, C, n_chunks,
-        interpret,
-    )
-    # [L, F, 4, B] -> [L, F, B, 3] (stats back to the trailing axis)
-    return out[:L, :F, :3, :num_bins].transpose(0, 1, 3, 2)
+        interpret, variant,
+    )  # [L+1, Fp, B, 4]
+    return out[:L, :F, :num_bins, :3]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "chunk", "interpret")
+    jax.jit, static_argnames=("num_bins", "chunk", "interpret", "variant")
 )
 def histogram_single_leaf(
     bins_T: jax.Array,  # [F, cap] binned rows of ONE leaf (masked)
@@ -199,6 +293,7 @@ def histogram_single_leaf(
     num_bins: int,
     chunk: int = 512,
     interpret: bool = False,
+    variant: str | None = None,
 ) -> jax.Array:
     """hist[F, num_bins, 3] for a single row set — the leaf-wise
     learner's per-split histogram (DenseBin::ConstructHistogram over the
@@ -213,7 +308,7 @@ def histogram_single_leaf(
     # exists to avoid
     C = max(128, (chunk // 128) * 128)
     B = _pad_pow(num_bins)
-    Fp = ((F + FGROUP - 1) // FGROUP) * FGROUP
+    Fp = ((F + FGROUP_BSUB - 1) // FGROUP_BSUB) * FGROUP_BSUB
     if Fp != F:
         bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
     pad = (-cap) % C
@@ -232,9 +327,9 @@ def histogram_single_leaf(
 
     out = _hist_pallas_call(
         jnp.zeros(n_chunks, jnp.int32), bins_T, stats, 1, Fp, B, C,
-        n_chunks, interpret,
-    )
-    return out[0, :F, :3, :num_bins].transpose(0, 2, 1)
+        n_chunks, interpret, variant,
+    )  # [1, Fp, B, 4]
+    return out[0, :F, :num_bins, :3]
 
 
 @functools.lru_cache(maxsize=None)
