@@ -47,8 +47,10 @@ DEFAULT_CHUNK = 1024
 FGROUP = 8  # feature rows per kernel loop step (int8 sublane-pack aligned)
 # bsub feature-group block height: the [C, 4] stats block is re-fetched
 # once per (feature-group, chunk) grid step, so wider groups amortize
-# that HBM traffic; 16 keeps the (1, FG, B, 4) accumulator block at
-# 16 x 256 x 128 lanes x 4B = 8.4MB of VMEM
+# that HBM traffic, while narrower groups waste less padding when F is
+# just past a multiple.  At 16 the (1, 16, B=256, 4->128 lanes)
+# accumulator block is ~2.1MB of VMEM — ample headroom, but 16 already
+# makes stats traffic (32B/row at F<=32) comparable to the bins traffic.
 FGROUP_BSUB = 16
 _VARIANTS = ("v1", "bsub")
 
@@ -230,7 +232,8 @@ def histogram_by_leaf_sorted(
     L = num_leaves
     C = chunk
     B = _pad_pow(num_bins)
-    Fp = ((F + FGROUP_BSUB - 1) // FGROUP_BSUB) * FGROUP_BSUB  # fits both groupings
+    fg = FGROUP if _kernel_variant(variant) == "v1" else FGROUP_BSUB
+    Fp = ((F + fg - 1) // fg) * fg  # pad to the selected kernel's grouping
     if Fp != F:
         bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
 
@@ -308,7 +311,8 @@ def histogram_single_leaf(
     # exists to avoid
     C = max(128, (chunk // 128) * 128)
     B = _pad_pow(num_bins)
-    Fp = ((F + FGROUP_BSUB - 1) // FGROUP_BSUB) * FGROUP_BSUB
+    fg = FGROUP if _kernel_variant(variant) == "v1" else FGROUP_BSUB
+    Fp = ((F + fg - 1) // fg) * fg
     if Fp != F:
         bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
     pad = (-cap) % C

@@ -1,0 +1,111 @@
+"""A/B the histogram kernel variants + end-to-end growth modes on TPU.
+
+Run when the chip is reachable:  python tools/kernel_ab.py [rows]
+
+Times, at bench shapes (F=28, B=255, L=255):
+  1. sorted level kernel, v1 vs bsub
+  2. single-leaf kernel (n/4 and n/16 rows), v1 vs bsub
+  3. leafwise + depthwise end-to-end s/tree for the variant selected by
+     LGBM_TPU_HIST_KERNEL (the hist-fn factories read the env at trace
+     time and are lru-cached, so run the script once per variant to get
+     both end-to-end numbers)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS = int(float(sys.argv[1])) if len(sys.argv) > 1 else 1_000_000
+
+
+def t(fn, reps=5):
+    import jax
+
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000
+
+
+def main():
+    import jax
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.pallas_histogram import (
+        histogram_by_leaf_sorted, histogram_single_leaf)
+
+    print("devices:", jax.devices(), flush=True)
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.RandomState(0)
+    F, B, L = 28, 255, 255
+    bins = jnp.asarray(rng.randint(0, B, (F, ROWS)).astype(np.uint8))
+    leaf = jnp.asarray(rng.randint(0, 128, ROWS).astype(np.int32))
+    g = jnp.asarray(rng.randn(ROWS).astype(np.float32))
+    ones = jnp.ones(ROWS, jnp.float32)
+
+    for variant in ("v1", "bsub"):
+        try:
+            ms = t(lambda: histogram_by_leaf_sorted(
+                bins, leaf, g, ones, ones, num_bins=B, num_leaves=L,
+                interpret=interpret, variant=variant))
+            print(f"sorted level kernel [{variant}]: {ms:.1f} ms", flush=True)
+        except Exception as e:
+            print(f"sorted level kernel [{variant}] FAILED: "
+                  f"{type(e).__name__}: {str(e)[:300]}", flush=True)
+        for frac in (4, 16):
+            m = ROWS // frac
+            try:
+                ms = t(lambda: histogram_single_leaf(
+                    bins[:, :m], g[:m], ones[:m], ones[:m], num_bins=B,
+                    interpret=interpret, variant=variant))
+                print(f"single-leaf kernel n/{frac} [{variant}]: {ms:.1f} ms",
+                      flush=True)
+            except Exception as e:
+                print(f"single-leaf n/{frac} [{variant}] FAILED: "
+                      f"{type(e).__name__}: {str(e)[:300]}", flush=True)
+
+    # end-to-end growth modes (uses LGBM_TPU_HIST_KERNEL env default)
+    import bench
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    X, y = bench.make_data(ROWS)
+    for growth in ("leafwise", "depthwise"):
+        cfg = Config(objective="binary", num_leaves=255, max_bin=255,
+                     learning_rate=0.1, min_data_in_leaf=100,
+                     metric=["auc"], tree_growth=growth)
+        ds = BinnedDataset.from_matrix(
+            X, Metadata(label=y.astype(np.float32)), config=cfg)
+        booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
+        t0 = time.perf_counter()
+        booster.train_one_iter()
+        _ = np.asarray(booster._scores[0, :1])
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        trees = 10
+        for _ in range(trees):
+            booster.train_one_iter()
+        _ = np.asarray(booster._scores)
+        t_tree = (time.perf_counter() - t0) / trees
+        auc = booster.eval_at(0).get("auc", float("nan"))
+        print(f"{growth} [{os.environ.get('LGBM_TPU_HIST_KERNEL', 'v1')}]: "
+              f"compile+1st {t_compile:.1f}s, {t_tree*1000:.0f} ms/tree, "
+              f"AUC {auc:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
