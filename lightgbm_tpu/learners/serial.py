@@ -363,25 +363,37 @@ def grow_tree(
                 begin_s, cnt_s, cap,
             ),
         )
-        # read the two slots BEFORE the in-place updates, behind a
-        # barrier so the reads can't fuse into the update computation —
-        # otherwise XLA's copy insertion duplicates the whole buffer
-        h_parent, h_prev_new = jax.lax.optimization_barrier(
-            (state.hists[best_leaf], state.hists[new_leaf])
-        )
+        h_parent = state.hists[best_leaf]
+        h_prev_new = state.hists[new_leaf]
         h_large = h_parent - h_small
         h_left = jnp.where(small_is_left, h_small, h_large)
         h_right = jnp.where(small_is_left, h_large, h_small)
-        # materialize once: the buffer update below and the child split
-        # searches must consume the SAME tensors — if the searches re-read
-        # slices of the pre-update buffer, it has to outlive the update
-        # and XLA copies the whole thing
-        h_left, h_right = jax.lax.optimization_barrier((h_left, h_right))
-        hists = (
-            state.hists.at[best_leaf]
-            .set(jnp.where(do_split, h_left, h_parent))
-            .at[new_leaf]
-            .set(jnp.where(do_split, h_right, h_prev_new))
+
+        # ---- child best splits (FindBestThresholds on the two new
+        # leaves) — computed BEFORE the buffer update so that every read
+        # of state.hists is finished by then (see barrier below)
+        depth_child = t.leaf_depth[best_leaf] + 1
+        best_l_new = best_for(h_left, lsg, lsh, lc, depth_child)
+        best_r_new = best_for(h_right, rsg, rsh, rc, depth_child)
+
+        # ---- in-place buffer update.  Everything derived from reads of
+        # state.hists (the stacked new rows and the child searches) goes
+        # through ONE optimization_barrier together with the buffer
+        # itself: after the barrier the buffer has no other live readers,
+        # so XLA's copy insertion lets the two-row scatter update it in
+        # place.  (Without this, the compiled while body copied the full
+        # [L, F, B, 3] buffer twice per split — measured in the HLO.)
+        new_rows = jnp.stack(
+            [
+                jnp.where(do_split, h_left, h_parent),
+                jnp.where(do_split, h_right, h_prev_new),
+            ]
+        )
+        new_rows, best_l_new, best_r_new, hists_in = jax.lax.optimization_barrier(
+            (new_rows, best_l_new, best_r_new, state.hists)
+        )
+        hists = hists_in.at[jnp.stack([best_leaf, new_leaf])].set(
+            new_rows, unique_indices=True
         )
 
         # ---- tree bookkeeping (Tree::Split, tree.cpp:52-96)
@@ -409,7 +421,6 @@ def grow_tree(
                 jnp.where(do_split, val, arr[i]).astype(arr.dtype)
             )
 
-        depth_child = t.leaf_depth[best_leaf] + 1
         tree = t._replace(
             num_leaves=t.num_leaves + do_split.astype(t.num_leaves.dtype),
             split_feature=m(t.split_feature, node, f),
@@ -432,9 +443,7 @@ def grow_tree(
             ),
         )
 
-        # ---- child best splits (FindBestThresholds on the two new leaves)
-        best_l = best_for(h_left, lsg, lsh, lc, depth_child)
-        best_r = best_for(h_right, rsg, rsh, rc, depth_child)
+        best_l, best_r = best_l_new, best_r_new
         old_l = SplitResult(*[b[best_leaf] for b in state.best])
         old_r = SplitResult(*[b[new_leaf] for b in state.best])
         best_l = SplitResult(
