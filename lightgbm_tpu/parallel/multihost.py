@@ -96,9 +96,45 @@ def initialize_from_config(cfg=None) -> bool:
             f"Initializing distributed runtime: coordinator={coord}, "
             f"num_processes={nproc}, process_id={pid}"
         )
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=nproc, process_id=pid
-        )
+        # failure handling mirrors the reference's socket bootstrap: a
+        # bounded retry loop (20 x 10s connect retries,
+        # linkers_socket.cpp:182-197) under the config's time_out budget
+        # (minutes, config.h:227).  jax.distributed's own
+        # initialization_timeout covers the coordinator barrier.
+        import time as _time
+
+        timeout_s = 60 * int(getattr(cfg, "time_out", 120) or 120)
+        attempts = 20
+        deadline = _time.monotonic() + timeout_s
+        for attempt in range(1, attempts + 1):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nproc,
+                    process_id=pid,
+                    initialization_timeout=max(
+                        10, min(timeout_s // attempts,
+                                int(deadline - _time.monotonic()) or 1),
+                    ),
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — retry any init failure
+                try:  # a failed initialize leaves jax's global client set;
+                    # without a shutdown every retry would instantly raise
+                    # "should only be called once"
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                if attempt == attempts or _time.monotonic() >= deadline:
+                    Log.fatal(
+                        f"distributed init failed (attempt {attempt}/"
+                        f"{attempts}, time_out={timeout_s // 60}min): "
+                        f"{type(e).__name__}: {e}"
+                    )
+                Log.warning(
+                    f"distributed init attempt {attempt}/{attempts} failed "
+                    f"({type(e).__name__}); retrying"
+                )
         return jax.process_count() > 1
     return False
 

@@ -65,15 +65,17 @@ def main():
                   f"{type(e).__name__}: {str(e)[:300]}", flush=True)
         for frac in (4, 16):
             m = ROWS // frac
-            try:
-                ms = t(lambda: histogram_single_leaf(
-                    bins[:, :m], g[:m], ones[:m], ones[:m], num_bins=B,
-                    interpret=interpret, variant=variant))
-                print(f"single-leaf kernel n/{frac} [{variant}]: {ms:.1f} ms",
-                      flush=True)
-            except Exception as e:
-                print(f"single-leaf n/{frac} [{variant}] FAILED: "
-                      f"{type(e).__name__}: {str(e)[:300]}", flush=True)
+            for chunk in (512, 1024, 2048):
+                try:
+                    ms = t(lambda: histogram_single_leaf(
+                        bins[:, :m], g[:m], ones[:m], ones[:m], num_bins=B,
+                        chunk=chunk, interpret=interpret, variant=variant))
+                    print(f"single-leaf n/{frac} chunk={chunk} [{variant}]: "
+                          f"{ms:.1f} ms", flush=True)
+                except Exception as e:
+                    print(f"single-leaf n/{frac} chunk={chunk} [{variant}] "
+                          f"FAILED: {type(e).__name__}: {str(e)[:300]}",
+                          flush=True)
 
     # end-to-end growth modes (uses LGBM_TPU_HIST_KERNEL env default)
     import bench
