@@ -90,6 +90,18 @@ class Dataset:
         params = key_alias_transform(dict(self.params))
         params.setdefault("max_bin", self.max_bin)
         cfg = Config.from_dict(params)
+        cats = self.categorical_feature
+        if any(isinstance(c, str) for c in cats):
+            # column-name entries resolve against feature_name
+            # (reference basic.py categorical_feature by str)
+            if not self.feature_name:
+                raise LightGBMError(
+                    "categorical_feature given by name requires feature_name"
+                )
+            cats = [
+                c if not isinstance(c, str) else self.feature_name.index(c)
+                for c in cats
+            ]
         meta_kwargs = dict(
             label=None if self.label is None else np.asarray(self.label),
             weights=self.weight,
@@ -129,7 +141,7 @@ class Dataset:
             else:
                 self._inner = BinnedDataset.from_csr(
                     indptr, indices, values, csr.shape[1], meta, config=cfg,
-                    categorical_features=self.categorical_feature,
+                    categorical_features=cats,
                     feature_names=self.feature_name,
                 )
         else:
@@ -143,7 +155,7 @@ class Dataset:
                     X,
                     meta,
                     config=cfg,
-                    categorical_features=self.categorical_feature,
+                    categorical_features=cats,
                     feature_names=self.feature_name,
                 )
         if self.free_raw_data:
@@ -200,6 +212,63 @@ class Dataset:
     set_group = lambda self, group: self.set_field("group", group)
     set_init_score = lambda self, s: self.set_field("init_score", s)
 
+    def get_group(self):
+        """Per-query group sizes (reference basic.py get_group =
+        get_field('group'))."""
+        g = self.get_field("group")
+        return None if g is None else np.asarray(g)
+
+    def _reset_or_refuse(self, what: str) -> None:
+        """Binning-input mutation after construction: rebin lazily when
+        the raw data is still held (reference basic.py drops its inner
+        dataset), refuse only once the raw data was freed."""
+        if self._inner is None:
+            return
+        if self.data is not None:
+            self._inner = None
+        else:
+            raise LightGBMError(
+                f"cannot change {what} after construction once raw data "
+                "was freed; create a new Dataset"
+            )
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Declare categorical columns by index or name, or 'auto'
+        (reference basic.py:1135-1147)."""
+        if isinstance(categorical_feature, str):
+            if categorical_feature != "auto":
+                raise LightGBMError(
+                    "categorical_feature must be a list of int/str or 'auto'"
+                )
+            cats = []
+        else:
+            cats = list(categorical_feature or [])
+        if cats != self.categorical_feature:
+            self._reset_or_refuse("categorical_feature")
+        self.categorical_feature = cats
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """Column names (reference basic.py set_feature_name)."""
+        names = list(feature_name) if feature_name is not None else None
+        if self._inner is not None and names is not None:
+            if len(names) != self._inner.num_total_features:
+                raise LightGBMError(
+                    f"expected {self._inner.num_total_features} feature "
+                    f"names, got {len(names)}"
+                )
+            self._inner.feature_names = names
+        self.feature_name = names
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Align this dataset's binning to another dataset's bin mappers
+        (reference basic.py set_reference)."""
+        if reference is not self.reference:
+            self._reset_or_refuse("reference")
+        self.reference = reference
+        return self
+
     def get_label(self):
         return self.get_field("label")
 
@@ -235,6 +304,7 @@ class Booster:
         self._train_dataset: Optional[Dataset] = None
         self.name_valid_sets: List[str] = []
         self.train_data_name = "training"
+        self._attr: Dict[str, str] = {}
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise LightGBMError("Training data should be Dataset instance")
@@ -272,6 +342,31 @@ class Booster:
             self._gbdt = GBDT(cfg)
         self._gbdt.load_model_from_string(model_str)
         self.config = cfg
+
+    # ----------------------------------------------------------- attributes
+    def attr(self, key: str) -> Optional[str]:
+        """Get a string attribute (reference basic.py attr)."""
+        return self._attr.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set string attributes; None deletes (reference basic.py
+        set_attr)."""
+        for key, value in kwargs.items():
+            if value is None:
+                self._attr.pop(key, None)
+            else:
+                if not isinstance(value, str):
+                    # ValueError for reference exception compatibility
+                    # (reference basic.py set_attr)
+                    raise ValueError("Set attr only accepts strings")
+                self._attr[key] = value
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Name used for the training set in eval output (reference
+        basic.py set_train_data_name)."""
+        self.train_data_name = name
+        return self
 
     # ------------------------------------------------------------- training
     def add_valid(self, data: Dataset, name: str) -> None:

@@ -135,6 +135,10 @@ def initialize_from_config(cfg=None) -> bool:
                     f"distributed init attempt {attempt}/{attempts} failed "
                     f"({type(e).__name__}); retrying"
                 )
+                # pace fast-failing errors (bad DNS, port still held by a
+                # restarting coordinator) like the reference's 10s-spaced
+                # connect retries, without overshooting the deadline
+                _time.sleep(min(10.0, max(0.0, deadline - _time.monotonic())))
         return jax.process_count() > 1
     return False
 

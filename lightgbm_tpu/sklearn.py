@@ -271,6 +271,32 @@ class LGBMModel(_SKLBase):
     def feature_importances_(self) -> np.ndarray:
         return self.booster_.feature_importance()
 
+    @property
+    def feature_importance_(self) -> np.ndarray:
+        """Normalized split-count importances (reference
+        sklearn.py:448-451)."""
+        arr = self.booster_.feature_importance().astype(np.float32)
+        total = arr.sum()
+        return arr / total if total else arr
+
+    def booster(self) -> Booster:
+        """Deprecated accessor kept for reference compatibility
+        (sklearn.py:454-456); use the ``booster_`` attribute."""
+        import warnings
+
+        warnings.warn("Use attribute booster_ instead.", DeprecationWarning)
+        return self.booster_
+
+    def feature_importance(self) -> np.ndarray:
+        """Deprecated accessor kept for reference compatibility
+        (sklearn.py:458-460); use ``feature_importance_``."""
+        import warnings
+
+        warnings.warn(
+            "Use attribute feature_importance_ instead.", DeprecationWarning
+        )
+        return self.feature_importance_
+
     def apply(self, X, num_iteration: int = -1):
         """Per-row leaf indices (sklearn.py predict with pred_leaf)."""
         return self.booster_.predict(X, pred_leaf=True, num_iteration=num_iteration)

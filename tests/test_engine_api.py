@@ -242,3 +242,67 @@ def test_continued_training_with_valid_set(tmp_path):
         return -np.mean(yv * np.log(p) + (1 - yv) * np.log(1 - p))
     assert evals["v"]["binary_logloss"][0] < logloss(direct) + 0.05
     assert evals["v"]["binary_logloss"][-1] <= evals["v"]["binary_logloss"][0]
+
+
+def test_dataset_and_booster_compat_surface():
+    """Reference-parity accessors: get_group, set_categorical_feature /
+    set_feature_name / set_reference (pre-construction), Booster
+    attr/set_attr/set_train_data_name (reference basic.py surface)."""
+    import numpy as np
+    import pytest
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, group=[60, 60])
+    np.testing.assert_array_equal(ds.get_group(), [60, 60])
+    ds.set_feature_name([f"f{i}" for i in range(4)])
+    ds.set_categorical_feature([3])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7}, ds,
+                    num_boost_round=2)
+    np.testing.assert_array_equal(ds.get_group(), [60, 60])
+
+    assert bst.attr("missing") is None
+    bst.set_attr(best="7", note="x")
+    assert bst.attr("best") == "7"
+    bst.set_attr(note=None)
+    assert bst.attr("note") is None
+    with pytest.raises(ValueError):  # reference raises ValueError here
+        bst.set_attr(bad=3)
+    bst.set_train_data_name("mytrain")
+    assert bst.train_data_name == "mytrain"
+
+    # post-construction mutation: rebins lazily while raw data is held
+    # (reference drops its inner dataset), refuses once raw data is freed
+    ds.set_categorical_feature([1])
+    assert ds._inner is None  # scheduled for reconstruction
+    ds.construct()
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=True)
+    lgb.train({"objective": "binary", "num_leaves": 7}, ds2, num_boost_round=1)
+    with pytest.raises(lgb.LightGBMError):
+        ds2.set_categorical_feature([1])
+    with pytest.raises(lgb.LightGBMError):
+        ds2.set_reference(lgb.Dataset(X, label=y))
+    # 'auto' and by-name declarations
+    ds3 = lgb.Dataset(X, label=y, feature_name=[f"c{i}" for i in range(4)])
+    ds3.set_categorical_feature("auto")
+    ds3.set_categorical_feature(["c2"])
+    assert np.asarray(ds3.construct().is_categorical)[2]
+
+
+def test_sklearn_deprecated_accessors():
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(200, 5)
+    y = (X[:, 1] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=3, num_leaves=7).fit(X, y)
+    norm = clf.feature_importance_
+    assert norm.dtype == np.float32 and abs(float(norm.sum()) - 1.0) < 1e-6
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        assert clf.booster() is clf.booster_
+        np.testing.assert_allclose(clf.feature_importance(), norm)
