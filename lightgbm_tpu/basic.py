@@ -98,10 +98,15 @@ class Dataset:
                 raise LightGBMError(
                     "categorical_feature given by name requires feature_name"
                 )
-            cats = [
-                c if not isinstance(c, str) else self.feature_name.index(c)
-                for c in cats
-            ]
+            try:
+                cats = [
+                    c if not isinstance(c, str) else self.feature_name.index(c)
+                    for c in cats
+                ]
+            except ValueError as e:
+                raise LightGBMError(
+                    f"categorical_feature name not in feature_name: {e}"
+                ) from None
         meta_kwargs = dict(
             label=None if self.label is None else np.asarray(self.label),
             weights=self.weight,
@@ -114,7 +119,8 @@ class Dataset:
         ref_inner = self.reference.construct() if self.reference is not None else None
         if isinstance(self.data, str):
             self._inner = BinnedDataset.from_file(
-                self.data, config=cfg, reference=ref_inner
+                self.data, config=cfg, reference=ref_inner,
+                categorical_features=cats or None,
             )
             if meta.label is not None:
                 self._inner.metadata.set_field("label", meta.label)
@@ -251,13 +257,20 @@ class Dataset:
     def set_feature_name(self, feature_name) -> "Dataset":
         """Column names (reference basic.py set_feature_name)."""
         names = list(feature_name) if feature_name is not None else None
-        if self._inner is not None and names is not None:
-            if len(names) != self._inner.num_total_features:
+        if names is not None:
+            expected = None
+            if self._inner is not None:
+                expected = self._inner.num_total_features
+            elif hasattr(self.data, "shape") and len(
+                getattr(self.data, "shape", ())
+            ) == 2:
+                expected = self.data.shape[1]
+            if expected is not None and len(names) != expected:
                 raise LightGBMError(
-                    f"expected {self._inner.num_total_features} feature "
-                    f"names, got {len(names)}"
+                    f"expected {expected} feature names, got {len(names)}"
                 )
-            self._inner.feature_names = names
+            if self._inner is not None:
+                self._inner.feature_names = names
         self.feature_name = names
         return self
 
@@ -535,6 +548,8 @@ class Booster:
             "params": self.params,
             "best_iteration": self.best_iteration,
             "model_str": self._gbdt.save_model_to_string(-1),
+            "attr": dict(self._attr),
+            "train_data_name": self.train_data_name,
         }
         return state
 
@@ -543,7 +558,8 @@ class Booster:
         self.best_iteration = state["best_iteration"]
         self._train_dataset = None
         self.name_valid_sets = []
-        self.train_data_name = "training"
+        self.train_data_name = state.get("train_data_name", "training")
+        self._attr = dict(state.get("attr", {}))
         self._init_from_string(state["model_str"])
 
     def __copy__(self):
@@ -553,6 +569,8 @@ class Booster:
         out = Booster(model_str=self._gbdt.save_model_to_string(-1),
                       params=copy.deepcopy(self.params))
         out.best_iteration = self.best_iteration
+        out._attr = dict(self._attr)
+        out.train_data_name = self.train_data_name
         return out
 
 

@@ -362,6 +362,7 @@ class BinnedDataset:
         config: Optional[Config] = None,
         reference: Optional["BinnedDataset"] = None,
         rank: Optional[int] = None,
+        categorical_features: Optional[Sequence[int]] = None,
     ) -> "BinnedDataset":
         """Load + bin a text data file (or its binary cache).
 
@@ -402,7 +403,8 @@ class BinnedDataset:
         )
         if want_stream and single_machine and fmt != "libsvm":
             return BinnedDataset._from_file_streaming(
-                path, config, fmt, reference=reference
+                path, config, fmt, reference=reference,
+                categorical_features=categorical_features,
             )
         raw, names = parse_file(path, has_header=config.has_header, fmt=fmt)
         side = Metadata.load_side_files(path)
@@ -433,6 +435,9 @@ class BinnedDataset:
             else [f"Column_{j}" for j in range(len(feat_cols))]
         )
         cat_inner = [feat_cols.index(c) for c in cats if c in feat_cols]
+        if categorical_features:
+            # API-level declaration, already in FEATURE space
+            cat_inner = sorted(set(cat_inner) | set(categorical_features))
         meta = Metadata(
             label=label,
             weights=weights,
@@ -498,6 +503,7 @@ class BinnedDataset:
         fmt: str,
         reference: Optional["BinnedDataset"] = None,
         chunk_rows: int = 200_000,
+        categorical_features: Optional[Sequence[int]] = None,
     ) -> "BinnedDataset":
         """Two-round loading (use_two_round_loading, dataset_loader.cpp:
         181-209): round one streams chunks to pull the bin-construction
@@ -547,6 +553,8 @@ class BinnedDataset:
                 offset += len(chunk)
             sample_raw = np.vstack(buf)
             cat_inner = [feat_cols.index(c) for c in cats if c in feat_cols]
+            if categorical_features:
+                cat_inner = sorted(set(cat_inner) | set(categorical_features))
             mappers_all = find_bin_mappers(
                 sample_raw,
                 total_sample_cnt=len(sample_idx),

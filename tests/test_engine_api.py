@@ -306,3 +306,39 @@ def test_sklearn_deprecated_accessors():
         warnings.simplefilter("ignore", DeprecationWarning)
         assert clf.booster() is clf.booster_
         np.testing.assert_allclose(clf.feature_importance(), norm)
+
+
+def test_booster_attrs_survive_pickle_and_file_categoricals(tmp_path):
+    import pickle
+    import numpy as np
+    import pytest
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7}, ds,
+                    num_boost_round=2)
+    bst.set_attr(tag="v1").set_train_data_name("t0")
+    b2 = pickle.loads(pickle.dumps(bst))
+    assert b2.attr("tag") == "v1" and b2.train_data_name == "t0"
+    import copy as _copy
+    b3 = _copy.deepcopy(bst)
+    assert b3.attr("tag") == "v1"
+
+    # file-path datasets honor API-level categorical declarations
+    data = str(tmp_path / "catfile.csv")
+    Xc = np.column_stack([rng.randn(300), rng.randint(0, 4, 300)])
+    np.savetxt(data, np.column_stack([y[:300], Xc]), fmt="%.6g", delimiter=",")
+    dsf = lgb.Dataset(data, categorical_feature=[1])
+    assert bool(np.asarray(dsf.construct().is_categorical)[1])
+
+    # wrong-length feature names rejected pre-construction for array data
+    with pytest.raises(lgb.LightGBMError):
+        lgb.Dataset(X, label=y).set_feature_name(["a", "b"])
+    # unknown categorical name -> LightGBMError at construct
+    bad = lgb.Dataset(X, label=y, feature_name=list("abcd"),
+                      categorical_feature=["zz"])
+    with pytest.raises(lgb.LightGBMError):
+        bad.construct()
