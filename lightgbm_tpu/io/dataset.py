@@ -114,6 +114,22 @@ def _resolve_roles(config: Config, names: Optional[List[str]]):
     return label_col, ignore, cats, weight_col, group_col
 
 
+
+def _merge_api_categoricals(cat_inner, categorical_features, num_features):
+    """Union API-level (FEATURE-space) categorical declarations into the
+    config-derived list, validating range — a typo'd index must not be a
+    silent no-op."""
+    if not categorical_features:
+        return cat_inner
+    bad = [c for c in categorical_features if not 0 <= int(c) < num_features]
+    if bad:
+        raise ValueError(
+            f"categorical_feature indices out of range: {bad} "
+            f"(num_features={num_features})"
+        )
+    return sorted(set(cat_inner) | {int(c) for c in categorical_features})
+
+
 def _resolve_column(spec: str, names: Optional[List[str]]) -> Optional[int]:
     """Resolve 'name:foo' or integer-string column spec to an index
     (dataset_loader.cpp:23-160)."""
@@ -378,6 +394,9 @@ class BinnedDataset:
             and os.path.exists(bin_path)
             and reference is None
             and config.num_machines <= 1
+            and not categorical_features
+            # a cached binary records nothing about API-level categorical
+            # declarations; honoring the declaration wins over the cache
         ):
             try:
                 ds = BinnedDataset.load_binary(bin_path)
@@ -392,7 +411,8 @@ class BinnedDataset:
         fmt = detect_file_format(path, config.has_header)
         if fmt == "libsvm" and not config.weight_column and not config.group_column:
             return BinnedDataset._from_libsvm_sparse(
-                path, config, reference=reference, rank=rank
+                path, config, reference=reference, rank=rank,
+                categorical_features=categorical_features,
             )
         single_machine = config.num_machines <= 1 or config.is_pre_partition
         # auto-stream only for files too big to comfortably hold as f64
@@ -434,10 +454,10 @@ class BinnedDataset:
             if names is not None
             else [f"Column_{j}" for j in range(len(feat_cols))]
         )
-        cat_inner = [feat_cols.index(c) for c in cats if c in feat_cols]
-        if categorical_features:
-            # API-level declaration, already in FEATURE space
-            cat_inner = sorted(set(cat_inner) | set(categorical_features))
+        cat_inner = _merge_api_categoricals(
+            [feat_cols.index(c) for c in cats if c in feat_cols],
+            categorical_features, len(feat_cols),
+        )
         meta = Metadata(
             label=label,
             weights=weights,
@@ -552,9 +572,10 @@ class BinnedDataset:
                     buf.append(chunk[sample_idx[lo:hi] - offset][:, feat_cols])
                 offset += len(chunk)
             sample_raw = np.vstack(buf)
-            cat_inner = [feat_cols.index(c) for c in cats if c in feat_cols]
-            if categorical_features:
-                cat_inner = sorted(set(cat_inner) | set(categorical_features))
+            cat_inner = _merge_api_categoricals(
+                [feat_cols.index(c) for c in cats if c in feat_cols],
+                categorical_features, len(feat_cols),
+            )
             mappers_all = find_bin_mappers(
                 sample_raw,
                 total_sample_cnt=len(sample_idx),
@@ -639,6 +660,7 @@ class BinnedDataset:
         config: Config,
         reference: Optional["BinnedDataset"] = None,
         rank: Optional[int] = None,
+        categorical_features: Optional[Sequence[int]] = None,
     ) -> "BinnedDataset":
         """LibSVM ingest in O(nnz) memory — streamed CSR parse, sparse
         bin finding with elided zeros, in-place bin encoding.  Replaces
@@ -669,11 +691,14 @@ class BinnedDataset:
             rows, indices, values = rows[keep], indices[keep], values[keep]
             row_lens = np.bincount(rows, minlength=n)
             indptr = np.concatenate([[0], np.cumsum(row_lens, dtype=np.int64)])
-        cats = [
-            j - 1
-            for j in _resolve_column_list(config.categorical_column, None)
-            if j >= 1
-        ]
+        cats = _merge_api_categoricals(
+            [
+                j - 1
+                for j in _resolve_column_list(config.categorical_column, None)
+                if j >= 1
+            ],
+            categorical_features, num_cols,
+        )
         meta = Metadata(
             label=label,
             weights=side.get("weights"),
