@@ -503,6 +503,26 @@ class Booster:
                 data = np.delete(raw, label_idx, axis=1)
             else:
                 data = raw
+        if hasattr(data, "tocsr"):
+            # sparse inputs: densify per row-chunk so peak memory is one
+            # chunk, not the whole matrix (the reference predicts CSR
+            # natively, c_api.cpp PredictForCSR; trees only read the
+            # split features of each row anyway).  The chunk row count
+            # scales with the width so the dense chunk stays ~256MB
+            # whatever the feature count.
+            n_rows, n_cols = data.shape
+            chunk_rows = max(1, (32 << 20) // max(1, n_cols))  # 32M f64 elems
+            if n_rows > chunk_rows:
+                csr = data.tocsr()
+                chunks = [
+                    self.predict(
+                        csr[i : i + chunk_rows].toarray(),
+                        num_iteration=num_iteration, raw_score=raw_score,
+                        pred_leaf=pred_leaf, is_reshape=is_reshape,
+                    )
+                    for i in range(0, n_rows, chunk_rows)
+                ]
+                return np.concatenate(chunks, axis=0)
         X = _densify(data)
         if pred_leaf:
             return self._gbdt.predict_leaf_index(X, num_iteration)

@@ -178,3 +178,29 @@ def test_sparse_training_end_to_end():
     np.testing.assert_allclose(
         b_dense.predict(dense), b_sparse.predict(dense), rtol=1e-6
     )
+
+
+def test_sparse_predict_chunked_matches_dense():
+    """Above the chunking threshold, scipy-sparse prediction densifies
+    per row-chunk (peak memory one chunk); results must equal the dense
+    path exactly."""
+    import numpy as np
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    n_tr = 2000
+    Xtr = rng.randn(n_tr, 8)
+    y = (Xtr[:, 0] + Xtr[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    lgb.Dataset(Xtr, label=y), num_boost_round=5)
+
+    n = 70_000  # crosses the 65536 chunk threshold
+    dense = np.zeros((n, 8))
+    mask = rng.rand(n, 8) < 0.1
+    dense[mask] = rng.randn(int(mask.sum()))
+    csr = sp.csr_matrix(dense)
+    p_dense = bst.predict(dense)
+    p_sparse = bst.predict(csr)
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-6)
+    assert p_sparse.shape == (n,)
