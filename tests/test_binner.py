@@ -90,3 +90,65 @@ def test_nan_maps_to_zero_bin():
     assert (
         m.value_to_bin(np.array([np.nan]))[0] == m.value_to_bin(np.array([0.0]))[0]
     )
+
+
+def test_greedy_equal_freq_matches_spec_fuzz():
+    """The closure-jumping _greedy_equal_freq must be bit-identical to
+    the reference's value-by-value loop (kept as _greedy_equal_freq_spec)
+    across count distributions: uniform, zipf-heavy (big-count bins),
+    few-distinct, constant-heavy, and tiny max_bin."""
+    import numpy as np
+    from lightgbm_tpu.io.binner import (
+        _greedy_equal_freq, _greedy_equal_freq_spec)
+
+    rng = np.random.RandomState(0)
+    cases = []
+    for trial in range(60):
+        kind = trial % 5
+        if kind == 0:
+            nv = rng.randint(2, 400)
+            counts = rng.randint(1, 20, nv)
+        elif kind == 1:
+            nv = rng.randint(2, 400)
+            counts = rng.zipf(1.5, nv).clip(1, 10_000)
+        elif kind == 2:
+            nv = rng.randint(2, 8)
+            counts = rng.randint(1, 2000, nv)
+        elif kind == 3:
+            nv = rng.randint(10, 100)
+            counts = np.ones(nv, np.int64)
+            counts[rng.randint(nv)] = 5000  # one dominant value
+        else:
+            nv = rng.randint(2, 3000)
+            counts = rng.randint(1, 5, nv)
+        max_bin = int(rng.choice([2, 3, 16, 255]))
+        distinct = np.sort(rng.randn(nv)).astype(np.float64)
+        cases.append((distinct, counts.astype(np.int64), max_bin))
+
+    for distinct, counts, max_bin in cases:
+        size = int(counts.sum())
+        ub_f, c0_f = _greedy_equal_freq(distinct, counts, size, max_bin)
+        ub_s, c0_s = _greedy_equal_freq_spec(distinct, counts, size, max_bin)
+        np.testing.assert_array_equal(ub_f, ub_s)
+        assert c0_f == c0_s, (c0_f, c0_s, max_bin, len(distinct))
+
+
+def test_greedy_equal_freq_spec_parity_with_elided_mass():
+    """sample_size may exceed counts.sum() (elided rows accounted at the
+    caller); the fast path must still track the spec's running mean."""
+    import numpy as np
+    from lightgbm_tpu.io.binner import (
+        _greedy_equal_freq, _greedy_equal_freq_spec)
+
+    rng = np.random.RandomState(7)
+    for _ in range(200):
+        nv = rng.randint(2, 300)
+        counts = rng.randint(1, 50, nv).astype(np.int64)
+        extra = int(rng.randint(0, 500))
+        size = int(counts.sum()) + extra
+        max_bin = int(rng.choice([2, 16, 255]))
+        distinct = np.sort(rng.randn(nv)).astype(np.float64)
+        ub_f, c0_f = _greedy_equal_freq(distinct, counts, size, max_bin)
+        ub_s, c0_s = _greedy_equal_freq_spec(distinct, counts, size, max_bin)
+        np.testing.assert_array_equal(ub_f, ub_s)
+        assert c0_f == c0_s
