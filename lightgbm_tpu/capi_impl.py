@@ -1,0 +1,299 @@
+"""Python side of the C API shim (src/capi/lgbm_capi.c).
+
+Implements the reference's ``LGBM_*`` semantics (include/LightGBM/
+c_api.h:60-607, src/c_api.cpp) over the in-process framework: handles
+are integer ids in a registry, caller buffers are read/written through
+ctypes from the raw addresses the C layer forwards.  The embedded
+interpreter holds the GIL for the duration of each call, which
+serializes mutations exactly like the reference Booster's mutex
+(c_api.cpp:231).
+
+Set ``LGBM_CAPI_PLATFORM`` (e.g. ``cpu``) before first use to pin the
+JAX platform — an embedded host usually wants explicit control.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+if os.environ.get("LGBM_CAPI_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["LGBM_CAPI_PLATFORM"])
+else:
+    # no explicit platform: probe the default backend with a timeout so a
+    # dead TPU tunnel degrades to CPU instead of hanging the host process
+    # on its first LGBM_* call (see lightgbm_tpu.backend)
+    from .backend import pin_cpu_if_default_dead
+
+    pin_cpu_if_default_dead(timeout_s=120.0)
+
+from .basic import Booster, Dataset, LightGBMError  # noqa: E402
+from .config import Config, key_alias_transform  # noqa: E402
+
+# c_api.h:32-39
+_DTYPE_F32, _DTYPE_F64, _DTYPE_I32, _DTYPE_I64 = 0, 1, 2, 3
+_PREDICT_NORMAL, _PREDICT_RAW, _PREDICT_LEAF = 0, 1, 2
+
+_NP_OF_DTYPE = {
+    _DTYPE_F32: np.float32,
+    _DTYPE_F64: np.float64,
+    _DTYPE_I32: np.int32,
+    _DTYPE_I64: np.int64,
+}
+
+_registry: Dict[int, Any] = {}
+_next_id = [1]
+# per-handle keep-alive store for LGBM_DatasetGetField out pointers
+_field_cache: Dict[int, Dict[str, np.ndarray]] = {}
+
+
+def _register(obj: Any) -> int:
+    h = _next_id[0]
+    _next_id[0] += 1
+    _registry[h] = obj
+    return h
+
+
+def _get(handle: int):
+    try:
+        return _registry[handle]
+    except KeyError:
+        raise LightGBMError(f"invalid handle {handle}") from None
+
+
+def _write_i64(addr: int, value: int) -> None:
+    ctypes.c_int64.from_address(addr).value = int(value)
+
+
+def _write_i32(addr: int, value: int) -> None:
+    ctypes.c_int32.from_address(addr).value = int(value)
+
+
+def _write_ptr(addr: int, value: int) -> None:
+    ctypes.c_void_p.from_address(addr).value = int(value)
+
+
+def _read_array(addr: int, count: int, dtype) -> np.ndarray:
+    n = int(count)
+    buf = (ctypes.c_char * (n * np.dtype(dtype).itemsize)).from_address(addr)
+    return np.frombuffer(buf, dtype=dtype, count=n).copy()
+
+
+def _write_array(addr: int, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    ctypes.memmove(addr, arr.ctypes.data, arr.nbytes)
+
+
+def _params_dict(parameters: str) -> Dict[str, str]:
+    """The CLI's key=value string form (Str2Map, c_api.cpp:36)."""
+    out: Dict[str, str] = {}
+    for tok in (parameters or "").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return key_alias_transform(out)
+
+
+def free_handle(handle: int) -> None:
+    _registry.pop(handle, None)
+    _field_cache.pop(handle, None)
+
+
+# ------------------------------------------------------------------ dataset
+def dataset_create_from_file(filename, parameters, reference, out_addr):
+    ref = _get(reference) if reference else None
+    ds = Dataset(filename, reference=ref, params=_params_dict(parameters))
+    ds.construct()
+    _write_ptr(out_addr, _register(ds))
+
+
+def dataset_create_from_mat(data_addr, data_type, nrow, ncol, is_row_major,
+                            parameters, reference, out_addr):
+    X = _read_array(data_addr, nrow * ncol, _NP_OF_DTYPE[data_type])
+    X = X.reshape((nrow, ncol) if is_row_major else (ncol, nrow))
+    if not is_row_major:
+        X = X.T
+    ref = _get(reference) if reference else None
+    # the reference constructs label-less in-memory datasets; labels
+    # arrive via LGBM_DatasetSetField before training (c_api.cpp:292-340)
+    ds = Dataset(np.asarray(X, np.float64),
+                 label=np.zeros(nrow, np.float32),
+                 reference=ref, params=_params_dict(parameters))
+    ds.construct()
+    _write_ptr(out_addr, _register(ds))
+
+
+def dataset_create_from_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                            data_type, nindptr, nelem, num_col, parameters,
+                            reference, out_addr):
+    import scipy.sparse as sp
+
+    indptr = _read_array(indptr_addr, nindptr, _NP_OF_DTYPE[indptr_type])
+    indices = _read_array(indices_addr, nelem, np.int32)
+    values = _read_array(data_addr, nelem, _NP_OF_DTYPE[data_type])
+    csr = sp.csr_matrix(
+        (values.astype(np.float64), indices, indptr.astype(np.int64)),
+        shape=(int(nindptr) - 1, int(num_col)),
+    )
+    ref = _get(reference) if reference else None
+    ds = Dataset(csr, label=np.zeros(csr.shape[0], np.float32),
+                 reference=ref, params=_params_dict(parameters))
+    ds.construct()
+    _write_ptr(out_addr, _register(ds))
+
+
+def dataset_set_field(handle, field_name, data_addr, num_element, dtype):
+    ds: Dataset = _get(handle)
+    arr = _read_array(data_addr, num_element, _NP_OF_DTYPE[dtype])
+    ds.set_field(field_name, arr)
+    _field_cache.pop(handle, None)
+
+
+def dataset_get_field(handle, field_name, out_len_addr, out_ptr_addr,
+                      out_type_addr):
+    ds: Dataset = _get(handle)
+    val = ds.get_field(field_name)
+    if val is None:
+        raise LightGBMError(f"field {field_name} is empty")
+    if field_name in ("group", "query"):
+        arr = np.ascontiguousarray(val, dtype=np.int32)
+        out_type = _DTYPE_I32
+    else:
+        arr = np.ascontiguousarray(val, dtype=np.float32)
+        out_type = _DTYPE_F32
+    # the returned pointer must outlive the call (the reference hands out
+    # internal vector storage, c_api.cpp); cache per handle+field
+    _field_cache.setdefault(handle, {})[field_name] = arr
+    _write_i64(out_len_addr, arr.shape[0])
+    _write_ptr(out_ptr_addr, arr.ctypes.data)
+    _write_i32(out_type_addr, out_type)
+
+
+def dataset_get_num_data(handle, out_addr):
+    _write_i64(out_addr, _get(handle).num_data())
+
+
+def dataset_get_num_feature(handle, out_addr):
+    _write_i64(out_addr, _get(handle).num_feature())
+
+
+def dataset_save_binary(handle, filename):
+    _get(handle).save_binary(filename)
+
+
+# ------------------------------------------------------------------ booster
+def booster_create(train_data, parameters, out_addr):
+    ds: Dataset = _get(train_data)
+    bst = Booster(params=_params_dict(parameters), train_set=ds)
+    _write_ptr(out_addr, _register(bst))
+
+
+def booster_create_from_modelfile(filename, out_num_iter_addr, out_addr):
+    bst = Booster(model_file=filename)
+    _write_i64(out_num_iter_addr,
+               bst.num_trees() // max(1, bst._gbdt.num_class))
+    _write_ptr(out_addr, _register(bst))
+
+
+def booster_add_valid_data(handle, valid_data):
+    bst: Booster = _get(handle)
+    bst.add_valid(_get(valid_data), name=f"valid_{len(bst.name_valid_sets)}")
+
+
+def booster_update_one_iter(handle, is_finished_addr):
+    finished = _get(handle).update()
+    _write_i32(is_finished_addr, 1 if finished else 0)
+
+
+def booster_rollback_one_iter(handle):
+    _get(handle).rollback_one_iter()
+
+
+def booster_get_current_iteration(handle, out_addr):
+    _write_i64(out_addr, _get(handle).current_iteration)
+
+
+def booster_get_num_classes(handle, out_addr):
+    _write_i64(out_addr, _get(handle)._gbdt.num_class)
+
+
+def _eval_names(bst: Booster) -> List[str]:
+    """Metric names WITHOUT evaluating (the reference reads its metric
+    objects, c_api.cpp GetEvalNames); empty for model-file-loaded
+    boosters, which carry no training metrics."""
+    names: List[str] = []
+    for m in getattr(bst._gbdt, "train_metrics", None) or []:
+        if hasattr(m, "eval_multi"):
+            names.extend(f"{m.name}@{k}" for k in m.eval_at)
+        else:
+            names.append(m.name)
+    return names
+
+
+def booster_get_eval_counts(handle, out_addr):
+    _write_i64(out_addr, len(_eval_names(_get(handle))))
+
+
+def booster_get_eval_names(handle, out_len_addr, out_strs_addr):
+    names = _eval_names(_get(handle))
+    _write_i64(out_len_addr, len(names))
+    # out_strs is a caller-allocated char*[]; write into each buffer
+    ptrs = _read_array(out_strs_addr, len(names), np.int64)
+    for p, name in zip(ptrs, names):
+        raw = name.encode() + b"\0"
+        ctypes.memmove(int(p), raw, len(raw))
+
+
+def booster_get_eval(handle, data_idx, out_len_addr, out_results_addr):
+    vals = [t[2] for t in _get(handle).eval(int(data_idx), "")]
+    arr = np.asarray(vals, np.float64)
+    _write_i64(out_len_addr, arr.shape[0])
+    _write_array(out_results_addr, arr)
+
+
+def booster_predict_for_mat(handle, data_addr, data_type, nrow, ncol,
+                            is_row_major, predict_type, num_iteration,
+                            out_len_addr, out_result_addr):
+    bst: Booster = _get(handle)
+    X = _read_array(data_addr, nrow * ncol, _NP_OF_DTYPE[data_type])
+    X = X.reshape((nrow, ncol) if is_row_major else (ncol, nrow))
+    if not is_row_major:
+        X = X.T
+    X = np.asarray(X, np.float64)
+    if predict_type == _PREDICT_LEAF:
+        res = bst.predict(X, pred_leaf=True, num_iteration=num_iteration)
+    elif predict_type == _PREDICT_RAW:
+        res = bst.predict(X, raw_score=True, num_iteration=num_iteration)
+    else:
+        res = bst.predict(X, num_iteration=num_iteration)
+    arr = np.ascontiguousarray(res, np.float64).reshape(-1)
+    _write_i64(out_len_addr, arr.shape[0])
+    _write_array(out_result_addr, arr)
+
+
+def booster_predict_for_file(handle, data_filename, data_has_header,
+                             predict_type, num_iteration, result_filename):
+    bst: Booster = _get(handle)
+    pred = bst.predict(
+        data_filename,
+        raw_score=predict_type == _PREDICT_RAW,
+        pred_leaf=predict_type == _PREDICT_LEAF,
+        num_iteration=num_iteration,
+        data_has_header=bool(data_has_header),
+    )
+    arr = np.asarray(pred)
+    with open(result_filename, "w") as fh:
+        if arr.ndim == 1:
+            fh.write("\n".join(repr(float(v)) for v in arr) + "\n")
+        else:
+            for row in arr:
+                fh.write("\t".join(repr(float(v)) for v in row) + "\n")
+
+
+def booster_save_model(handle, num_iteration, filename):
+    _get(handle).save_model(filename, num_iteration=num_iteration)

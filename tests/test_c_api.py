@@ -1,0 +1,156 @@
+"""C API shim smoke test — the reference's own FFI round trip
+(tests/c_api_test/test.py: load the shared lib with ctypes, build
+datasets from file and from matrices, train with eval, predict through
+both the live booster and a saved+reloaded model) against
+lib_lightgbm_tpu.so (src/capi/lgbm_capi.c)."""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "lightgbm_tpu", "lib", "lib_lightgbm_tpu.so")
+REF_TRAIN = "/root/reference/examples/binary_classification/binary.train"
+REF_TEST = "/root/reference/examples/binary_classification/binary.test"
+
+F32, F64, I32, I64 = 0, 1, 2, 3
+PRED_NORMAL, PRED_RAW, PRED_LEAF = 0, 1, 2
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make"], cwd=os.path.join(ROOT, "src", "capi"),
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"cannot build C API shim: {r.stderr[-300:]}")
+    dll = ctypes.CDLL(LIB)
+    dll.LGBM_GetLastError.restype = ctypes.c_char_p
+    return dll
+
+
+def _ok(dll, rc):
+    assert rc == 0, dll.LGBM_GetLastError().decode()
+
+
+def test_c_api_full_round_trip(lib, tmp_path):
+    if not os.path.exists(REF_TRAIN):
+        pytest.skip("reference example data unavailable")
+    params = b"objective=binary num_leaves=15 metric=binary_logloss,auc verbose=-1"
+
+    # ---- dataset from file + aligned valid set
+    train = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_DatasetCreateFromFile(
+        REF_TRAIN.encode(), params, None, ctypes.byref(train)))
+    valid = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_DatasetCreateFromFile(
+        REF_TEST.encode(), params, train, ctypes.byref(valid)))
+    n = ctypes.c_int64()
+    _ok(lib, lib.LGBM_DatasetGetNumData(train, ctypes.byref(n)))
+    assert n.value == 7000
+    _ok(lib, lib.LGBM_DatasetGetNumFeature(train, ctypes.byref(n)))
+    assert n.value == 28
+
+    # ---- booster: train with eval
+    bst = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_BoosterCreate(train, params, ctypes.byref(bst)))
+    _ok(lib, lib.LGBM_BoosterAddValidData(bst, valid))
+    fin = ctypes.c_int()
+    for _ in range(10):
+        _ok(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    it = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 10
+
+    cnt = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)))
+    assert cnt.value == 2  # logloss + auc
+    bufs = [ctypes.create_string_buffer(64) for _ in range(cnt.value)]
+    arr = (ctypes.c_char_p * cnt.value)(*[ctypes.addressof(b) for b in bufs])
+    _ok(lib, lib.LGBM_BoosterGetEvalNames(bst, ctypes.byref(cnt), arr))
+    names = [b.value.decode() for b in bufs]
+    assert set(names) == {"binary_logloss", "auc"}
+
+    res = (ctypes.c_double * cnt.value)()
+    _ok(lib, lib.LGBM_BoosterGetEval(bst, 1, ctypes.byref(cnt), res))
+    evals = dict(zip(names, list(res)))
+    assert 0 < evals["binary_logloss"] < 0.7
+    assert 0.7 < evals["auc"] <= 1.0
+
+    # ---- in-memory dataset from mat with labels via SetField
+    rng = np.random.RandomState(0)
+    Xm = rng.randn(500, 6)
+    ym = (Xm[:, 0] > 0).astype(np.float32)
+    dmat = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_DatasetCreateFromMat(
+        np.ascontiguousarray(Xm).ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(F64), ctypes.c_int32(500), ctypes.c_int32(6),
+        ctypes.c_int(1), b"num_leaves=7 verbose=-1", None, ctypes.byref(dmat)))
+    _ok(lib, lib.LGBM_DatasetSetField(
+        dmat, b"label", ym.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(500), ctypes.c_int(F32)))
+    out_len = ctypes.c_int64()
+    out_ptr = ctypes.c_void_p()
+    out_type = ctypes.c_int()
+    _ok(lib, lib.LGBM_DatasetGetField(
+        dmat, b"label", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)))
+    assert out_len.value == 500 and out_type.value == F32
+    got = np.frombuffer(
+        (ctypes.c_char * (500 * 4)).from_address(out_ptr.value), np.float32)
+    np.testing.assert_array_equal(got, ym)
+
+    # ---- predict via live booster, saved model, and result file
+    Xv = np.loadtxt(REF_TEST)[:, 1:]
+    nrow = Xv.shape[0]
+    pred = (ctypes.c_double * nrow)()
+    plen = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterPredictForMat(
+        bst, np.ascontiguousarray(Xv).ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(F64), ctypes.c_int32(nrow), ctypes.c_int32(Xv.shape[1]),
+        ctypes.c_int(1), ctypes.c_int(PRED_NORMAL), ctypes.c_int64(-1),
+        ctypes.byref(plen), pred))
+    assert plen.value == nrow
+    p_live = np.asarray(list(pred))
+    assert 0.0 <= p_live.min() and p_live.max() <= 1.0
+
+    model = str(tmp_path / "capi_model.txt").encode()
+    _ok(lib, lib.LGBM_BoosterSaveModel(bst, ctypes.c_int(-1), model))
+    n_iter = ctypes.c_int64()
+    bst2 = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_BoosterCreateFromModelfile(
+        model, ctypes.byref(n_iter), ctypes.byref(bst2)))
+    assert n_iter.value == 10
+    # model-file boosters carry no training metrics: eval count is 0
+    _ok(lib, lib.LGBM_BoosterGetEvalCounts(bst2, ctypes.byref(cnt)))
+    assert cnt.value == 0
+
+    pred2 = (ctypes.c_double * nrow)()
+    _ok(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, np.ascontiguousarray(Xv).ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(F64), ctypes.c_int32(nrow), ctypes.c_int32(Xv.shape[1]),
+        ctypes.c_int(1), ctypes.c_int(PRED_NORMAL), ctypes.c_int64(-1),
+        ctypes.byref(plen), pred2))
+    np.testing.assert_allclose(np.asarray(list(pred2)), p_live, atol=1e-6)
+
+    result = str(tmp_path / "capi_pred.txt").encode()
+    _ok(lib, lib.LGBM_BoosterPredictForFile(
+        bst, REF_TEST.encode(), ctypes.c_int(0), ctypes.c_int(PRED_NORMAL),
+        ctypes.c_int64(-1), result))
+    p_file = np.loadtxt(result.decode())
+    np.testing.assert_allclose(p_file, p_live, atol=1e-6)
+
+    # ---- error surface
+    bad = lib.LGBM_DatasetCreateFromFile(
+        b"/definitely/missing.csv", params, None, ctypes.byref(train))
+    assert bad == -1
+    err = lib.LGBM_GetLastError()
+    assert err and b"everything is fine" not in err  # error was propagated
+
+    for h in (train, valid, dmat):
+        _ok(lib, lib.LGBM_DatasetFree(h))
+    _ok(lib, lib.LGBM_BoosterFree(bst))
+    _ok(lib, lib.LGBM_BoosterFree(bst2))
