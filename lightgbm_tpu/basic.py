@@ -393,11 +393,7 @@ class Booster:
         """One boosting iteration; returns True if no further training is
         possible (basic.py:1431-1501)."""
         if train_set is not None and train_set is not self._train_dataset:
-            inner = train_set.construct()
-            obj = create_objective(self.config, inner.metadata, inner.num_data) \
-                if self.config.objective != "none" else None
-            self._gbdt.reset_training_data(inner, obj)
-            self._train_dataset = train_set
+            self._reset_train_data(train_set)
         if fobj is None:
             return self._gbdt.train_one_iter()
         grad, hess = fobj(self.__inner_predict_flat(0), self._train_dataset)
@@ -410,6 +406,15 @@ class Booster:
                 f"don't match training rows x classes ({n})"
             )
         return self._gbdt.train_one_iter(grad, hess)
+
+    def _reset_train_data(self, train_set: Dataset) -> None:
+        """LGBM_BoosterResetTrainingData semantics, shared by update()'s
+        train_set branch and the C API shim."""
+        inner = train_set.construct()
+        obj = create_objective(self.config, inner.metadata, inner.num_data) \
+            if self.config.objective != "none" else None
+        self._gbdt.reset_training_data(inner, obj)
+        self._train_dataset = train_set
 
     def rollback_one_iter(self) -> None:
         self._gbdt.rollback_one_iter()

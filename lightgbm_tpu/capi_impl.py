@@ -99,6 +99,34 @@ def _params_dict(parameters: str) -> Dict[str, str]:
     return key_alias_transform(out)
 
 
+def _write_string_array(addr: int, names) -> None:
+    """Write strings into a caller-allocated char*[] (the reference's
+    GetEvalNames/GetFeatureNames out convention)."""
+    ptrs = _read_array(addr, len(names), np.int64)
+    for p, name in zip(ptrs, names):
+        raw = name.encode() + b"\0"
+        ctypes.memmove(int(p), raw, len(raw))
+
+
+def _read_sparse_csr(ptr_addr, ptr_type, indices_addr, data_addr, data_type,
+                     nptr, nelem, other_dim, order):
+    """Rebuild a scipy matrix from caller CSR/CSC buffers; returns CSR."""
+    import scipy.sparse as sp
+
+    ptr = _read_array(ptr_addr, nptr, _NP_OF_DTYPE[ptr_type]).astype(np.int64)
+    indices = _read_array(indices_addr, nelem, np.int32)
+    values = _read_array(data_addr, nelem, _NP_OF_DTYPE[data_type]).astype(
+        np.float64
+    )
+    if order == "csr":
+        m = sp.csr_matrix((values, indices, ptr),
+                          shape=(int(nptr) - 1, int(other_dim)))
+        return m
+    m = sp.csc_matrix((values, indices, ptr),
+                      shape=(int(other_dim), int(nptr) - 1))
+    return m.tocsr()
+
+
 def free_handle(handle: int) -> None:
     _registry.pop(handle, None)
     _field_cache.pop(handle, None)
@@ -131,15 +159,8 @@ def dataset_create_from_mat(data_addr, data_type, nrow, ncol, is_row_major,
 def dataset_create_from_csr(indptr_addr, indptr_type, indices_addr, data_addr,
                             data_type, nindptr, nelem, num_col, parameters,
                             reference, out_addr):
-    import scipy.sparse as sp
-
-    indptr = _read_array(indptr_addr, nindptr, _NP_OF_DTYPE[indptr_type])
-    indices = _read_array(indices_addr, nelem, np.int32)
-    values = _read_array(data_addr, nelem, _NP_OF_DTYPE[data_type])
-    csr = sp.csr_matrix(
-        (values.astype(np.float64), indices, indptr.astype(np.int64)),
-        shape=(int(nindptr) - 1, int(num_col)),
-    )
+    csr = _read_sparse_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                           data_type, nindptr, nelem, num_col, "csr")
     ref = _get(reference) if reference else None
     ds = Dataset(csr, label=np.zeros(csr.shape[0], np.float32),
                  reference=ref, params=_params_dict(parameters))
@@ -242,11 +263,7 @@ def booster_get_eval_counts(handle, out_addr):
 def booster_get_eval_names(handle, out_len_addr, out_strs_addr):
     names = _eval_names(_get(handle))
     _write_i64(out_len_addr, len(names))
-    # out_strs is a caller-allocated char*[]; write into each buffer
-    ptrs = _read_array(out_strs_addr, len(names), np.int64)
-    for p, name in zip(ptrs, names):
-        raw = name.encode() + b"\0"
-        ctypes.memmove(int(p), raw, len(raw))
+    _write_string_array(out_strs_addr, names)
 
 
 def booster_get_eval(handle, data_idx, out_len_addr, out_results_addr):
@@ -297,3 +314,164 @@ def booster_predict_for_file(handle, data_filename, data_has_header,
 
 def booster_save_model(handle, num_iteration, filename):
     _get(handle).save_model(filename, num_iteration=num_iteration)
+
+
+def dataset_create_from_csc(col_ptr_addr, col_ptr_type, indices_addr,
+                            data_addr, data_type, ncol_ptr, nelem, num_row,
+                            parameters, reference, out_addr):
+    csr = _read_sparse_csr(col_ptr_addr, col_ptr_type, indices_addr,
+                           data_addr, data_type, ncol_ptr, nelem, num_row,
+                           "csc")
+    ref = _get(reference) if reference else None
+    ds = Dataset(csr, label=np.zeros(int(num_row), np.float32),
+                 reference=ref, params=_params_dict(parameters))
+    ds.construct()
+    _write_ptr(out_addr, _register(ds))
+
+
+def dataset_get_subset(handle, indices_addr, num_indices, parameters,
+                       out_addr):
+    ds: Dataset = _get(handle)
+    idx = _read_array(indices_addr, num_indices, np.int32)
+    sub = ds.subset(idx, params=_params_dict(parameters) or None)
+    _write_ptr(out_addr, _register(sub))
+
+
+def dataset_set_feature_names(handle, names_addr, num_names):
+    ds: Dataset = _get(handle)
+    ptrs = _read_array(names_addr, num_names, np.int64)
+    names = [ctypes.c_char_p(int(p)).value.decode() for p in ptrs]
+    ds.set_feature_name(names)
+
+
+def dataset_get_feature_names(handle, names_addr, out_num_addr):
+    ds: Dataset = _get(handle)
+    names = ds.construct().feature_names
+    _write_i64(out_num_addr, len(names))
+    _write_string_array(names_addr, names)
+
+
+def booster_merge(handle, other_handle):
+    _get(handle)._gbdt.merge_from(_get(other_handle)._gbdt)
+
+
+def booster_reset_training_data(handle, train_data):
+    _get(handle)._reset_train_data(_get(train_data))
+
+
+def booster_reset_parameter(handle, parameters):
+    _get(handle).reset_parameter(_params_dict(parameters))
+
+
+def booster_update_one_iter_custom(handle, grad_addr, hess_addr,
+                                   is_finished_addr):
+    bst: Booster = _get(handle)
+    n = bst._gbdt.num_data * bst._gbdt.num_class
+    grad = _read_array(grad_addr, n, np.float32)
+    hess = _read_array(hess_addr, n, np.float32)
+    finished = bst._gbdt.train_one_iter(grad, hess)
+    _write_i32(is_finished_addr, 1 if finished else 0)
+
+
+def booster_get_num_predict(handle, data_idx, out_len_addr):
+    gb = _get(handle)._gbdt
+    n = gb.num_data if data_idx == 0 else gb.valid_sets[data_idx - 1].num_data
+    _write_i64(out_len_addr, int(n) * gb.num_class)
+
+
+def booster_get_predict(handle, data_idx, out_len_addr, out_result_addr):
+    """Objective-transformed inner predictions in ROW-major
+    [num_data, num_class] (GBDT::GetPredictAt, gbdt.cpp:388-426)."""
+    gb = _get(handle)._gbdt
+    scores = np.asarray(gb.predict_at(int(data_idx)))  # [K, n] raw
+    if gb.sigmoid > 0 and gb.num_class == 1 and gb.objective_name() == "binary":
+        out = 1.0 / (1.0 + np.exp(-2.0 * gb.sigmoid * scores[0]))
+    elif gb.num_class > 1:
+        z = scores - scores.max(axis=0, keepdims=True)
+        e = np.exp(z)
+        out = (e / e.sum(axis=0, keepdims=True)).T
+    else:
+        out = scores[0]
+    arr = np.ascontiguousarray(out, np.float64).reshape(-1)
+    _write_i64(out_len_addr, arr.shape[0])
+    _write_array(out_result_addr, arr)
+
+
+def booster_calc_num_predict(handle, num_row, predict_type, num_iteration,
+                             out_len_addr):
+    gb = _get(handle)._gbdt
+    K = gb.num_class
+    if predict_type == _PREDICT_LEAF:
+        total_iter = gb.num_trees // max(1, K)
+        n_iter = total_iter if num_iteration <= 0 else min(
+            int(num_iteration), total_iter
+        )
+        per_row = n_iter * K
+    else:
+        per_row = K
+    _write_i64(out_len_addr, int(num_row) * per_row)
+
+
+def _predict_sparse(handle, csr, predict_type, num_iteration, out_len_addr,
+                    out_result_addr):
+    bst: Booster = _get(handle)
+    if predict_type == _PREDICT_LEAF:
+        res = bst.predict(csr, pred_leaf=True, num_iteration=num_iteration)
+    elif predict_type == _PREDICT_RAW:
+        res = bst.predict(csr, raw_score=True, num_iteration=num_iteration)
+    else:
+        res = bst.predict(csr, num_iteration=num_iteration)
+    arr = np.ascontiguousarray(res, np.float64).reshape(-1)
+    _write_i64(out_len_addr, arr.shape[0])
+    _write_array(out_result_addr, arr)
+
+
+def booster_predict_for_csr(handle, indptr_addr, indptr_type, indices_addr,
+                            data_addr, data_type, nindptr, nelem, num_col,
+                            predict_type, num_iteration, out_len_addr,
+                            out_result_addr):
+    csr = _read_sparse_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                           data_type, nindptr, nelem, num_col, "csr")
+    _predict_sparse(handle, csr, predict_type, num_iteration, out_len_addr,
+                    out_result_addr)
+
+
+def booster_predict_for_csc(handle, col_ptr_addr, col_ptr_type, indices_addr,
+                            data_addr, data_type, ncol_ptr, nelem, num_row,
+                            predict_type, num_iteration, out_len_addr,
+                            out_result_addr):
+    csr = _read_sparse_csr(col_ptr_addr, col_ptr_type, indices_addr,
+                           data_addr, data_type, ncol_ptr, nelem, num_row,
+                           "csc")
+    _predict_sparse(handle, csr, predict_type, num_iteration, out_len_addr,
+                    out_result_addr)
+
+
+def booster_dump_model(handle, num_iteration, buffer_len, out_len_addr,
+                       out_str_addr):
+    import json
+
+    txt = json.dumps(_get(handle).dump_model(num_iteration=num_iteration))
+    raw = txt.encode() + b"\0"
+    _write_i64(out_len_addr, len(raw))
+    if buffer_len >= len(raw):
+        ctypes.memmove(out_str_addr, raw, len(raw))
+
+
+def booster_get_leaf_value(handle, tree_idx, leaf_idx, out_val_addr):
+    gb = _get(handle)._gbdt
+    val = float(np.asarray(gb.models[tree_idx].leaf_value)[leaf_idx])
+    ctypes.c_double.from_address(out_val_addr).value = val
+
+
+def booster_set_leaf_value(handle, tree_idx, leaf_idx, val):
+    import jax.numpy as jnp
+
+    gb = _get(handle)._gbdt
+    tree = gb.models[tree_idx]
+    gb.models[tree_idx] = tree._replace(
+        leaf_value=jnp.asarray(tree.leaf_value).at[int(leaf_idx)].set(
+            jnp.float32(val)
+        )
+    )
+    gb._model_version += 1

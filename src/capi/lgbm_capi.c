@@ -155,6 +155,44 @@ DllExport int LGBM_DatasetCreateFromCSR(const void *indptr, int indptr_type,
                    parameters, ADDR(reference), ADDR(out));
 }
 
+DllExport int LGBM_DatasetCreateFromCSC(const void *col_ptr, int col_ptr_type,
+                                        const int32_t *indices,
+                                        const void *data, int data_type,
+                                        int64_t ncol_ptr, int64_t nelem,
+                                        int64_t num_row,
+                                        const char *parameters,
+                                        const DatasetHandle reference,
+                                        DatasetHandle *out) {
+  return lgbm_call("dataset_create_from_csc", "(LiLLiLLLsLL)", ADDR(col_ptr),
+                   col_ptr_type, ADDR(indices), ADDR(data), data_type,
+                   (long long)ncol_ptr, (long long)nelem, (long long)num_row,
+                   parameters, ADDR(reference), ADDR(out));
+}
+
+DllExport int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                                    const int32_t *used_row_indices,
+                                    int32_t num_used_row_indices,
+                                    const char *parameters,
+                                    DatasetHandle *out) {
+  return lgbm_call("dataset_get_subset", "(LLisL)", ADDR(handle),
+                   ADDR(used_row_indices), (int)num_used_row_indices,
+                   parameters, ADDR(out));
+}
+
+DllExport int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                          const char **feature_names,
+                                          int64_t num_feature_names) {
+  return lgbm_call("dataset_set_feature_names", "(LLL)", ADDR(handle),
+                   ADDR(feature_names), (long long)num_feature_names);
+}
+
+DllExport int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                          char **feature_names,
+                                          int64_t *num_feature_names) {
+  return lgbm_call("dataset_get_feature_names", "(LLL)", ADDR(handle),
+                   ADDR(feature_names), ADDR(num_feature_names));
+}
+
 DllExport int LGBM_DatasetSetField(DatasetHandle handle,
                                    const char *field_name,
                                    const void *field_data,
@@ -204,6 +242,101 @@ DllExport int LGBM_BoosterCreateFromModelfile(const char *filename,
 
 DllExport int LGBM_BoosterFree(BoosterHandle handle) {
   return lgbm_call("free_handle", "(L)", ADDR(handle));
+}
+
+DllExport int LGBM_BoosterMerge(BoosterHandle handle,
+                                BoosterHandle other_handle) {
+  return lgbm_call("booster_merge", "(LL)", ADDR(handle), ADDR(other_handle));
+}
+
+DllExport int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                            const DatasetHandle train_data) {
+  return lgbm_call("booster_reset_training_data", "(LL)", ADDR(handle),
+                   ADDR(train_data));
+}
+
+DllExport int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                         const char *parameters) {
+  return lgbm_call("booster_reset_parameter", "(Ls)", ADDR(handle),
+                   parameters);
+}
+
+DllExport int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                              const float *grad,
+                                              const float *hess,
+                                              int *is_finished) {
+  return lgbm_call("booster_update_one_iter_custom", "(LLLL)", ADDR(handle),
+                   ADDR(grad), ADDR(hess), ADDR(is_finished));
+}
+
+DllExport int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                                        int64_t *out_len) {
+  return lgbm_call("booster_get_num_predict", "(LiL)", ADDR(handle), data_idx,
+                   ADDR(out_len));
+}
+
+DllExport int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                                     int64_t *out_len, double *out_result) {
+  return lgbm_call("booster_get_predict", "(LiLL)", ADDR(handle), data_idx,
+                   ADDR(out_len), ADDR(out_result));
+}
+
+DllExport int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int64_t num_row,
+                                         int predict_type,
+                                         int64_t num_iteration,
+                                         int64_t *out_len) {
+  return lgbm_call("booster_calc_num_predict", "(LLiLL)", ADDR(handle),
+                   (long long)num_row, predict_type,
+                   (long long)num_iteration, ADDR(out_len));
+}
+
+DllExport int LGBM_BoosterPredictForCSR(BoosterHandle handle,
+                                        const void *indptr, int indptr_type,
+                                        const int32_t *indices,
+                                        const void *data, int data_type,
+                                        int64_t nindptr, int64_t nelem,
+                                        int64_t num_col, int predict_type,
+                                        int64_t num_iteration,
+                                        int64_t *out_len, double *out_result) {
+  return lgbm_call("booster_predict_for_csr", "(LLiLLiLLLiLLL)", ADDR(handle),
+                   ADDR(indptr), indptr_type, ADDR(indices), ADDR(data),
+                   data_type, (long long)nindptr, (long long)nelem,
+                   (long long)num_col, predict_type, (long long)num_iteration,
+                   ADDR(out_len), ADDR(out_result));
+}
+
+DllExport int LGBM_BoosterPredictForCSC(BoosterHandle handle,
+                                        const void *col_ptr, int col_ptr_type,
+                                        const int32_t *indices,
+                                        const void *data, int data_type,
+                                        int64_t ncol_ptr, int64_t nelem,
+                                        int64_t num_row, int predict_type,
+                                        int64_t num_iteration,
+                                        int64_t *out_len, double *out_result) {
+  return lgbm_call("booster_predict_for_csc", "(LLiLLiLLLiLLL)", ADDR(handle),
+                   ADDR(col_ptr), col_ptr_type, ADDR(indices), ADDR(data),
+                   data_type, (long long)ncol_ptr, (long long)nelem,
+                   (long long)num_row, predict_type, (long long)num_iteration,
+                   ADDR(out_len), ADDR(out_result));
+}
+
+DllExport int LGBM_BoosterDumpModel(BoosterHandle handle, int num_iteration,
+                                    int buffer_len, int64_t *out_len,
+                                    char *out_str) {
+  return lgbm_call("booster_dump_model", "(LiiLL)", ADDR(handle),
+                   num_iteration, buffer_len, ADDR(out_len), ADDR(out_str));
+}
+
+DllExport int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                                       int leaf_idx, double *out_val) {
+  return lgbm_call("booster_get_leaf_value", "(LiiL)", ADDR(handle), tree_idx,
+                   leaf_idx, ADDR(out_val));
+}
+
+DllExport int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                                       int leaf_idx, double val) {
+  return lgbm_call("booster_set_leaf_value", "(Liid)", ADDR(handle), tree_idx,
+                   leaf_idx, val);
 }
 
 DllExport int LGBM_BoosterAddValidData(BoosterHandle handle,

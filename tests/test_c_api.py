@@ -80,6 +80,15 @@ def test_c_api_full_round_trip(lib, tmp_path):
     assert 0 < evals["binary_logloss"] < 0.7
     assert 0.7 < evals["auc"] <= 1.0
 
+    # inner train predictions are objective-transformed (GetPredictAt)
+    np_len = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterGetNumPredict(bst, 0, ctypes.byref(np_len)))
+    assert np_len.value == 7000
+    inner = (ctypes.c_double * 7000)()
+    _ok(lib, lib.LGBM_BoosterGetPredict(bst, 0, ctypes.byref(np_len), inner))
+    iv = np.asarray(list(inner))
+    assert 0.0 < iv.min() and iv.max() < 1.0  # sigmoid-transformed
+
     # ---- in-memory dataset from mat with labels via SetField
     rng = np.random.RandomState(0)
     Xm = rng.randn(500, 6)
@@ -151,6 +160,130 @@ def test_c_api_full_round_trip(lib, tmp_path):
     assert err and b"everything is fine" not in err  # error was propagated
 
     for h in (train, valid, dmat):
+        _ok(lib, lib.LGBM_DatasetFree(h))
+    _ok(lib, lib.LGBM_BoosterFree(bst))
+    _ok(lib, lib.LGBM_BoosterFree(bst2))
+
+
+def test_c_api_extended_surface(lib, tmp_path):
+    """CSR datasets + sparse prediction, subsets, feature names, custom
+    gradients, inner-prediction access, merge, dump, leaf get/set —
+    the remainder of the 40-function surface (c_api.h:60-607)."""
+    import scipy.sparse as sp
+
+    rng = np.random.RandomState(1)
+    Xd = rng.randn(400, 5)
+    Xd[rng.rand(400, 5) < 0.5] = 0.0
+    y = (Xd[:, 0] + Xd[:, 1] > 0).astype(np.float32)
+    csr = sp.csr_matrix(Xd)
+    indptr = csr.indptr.astype(np.int32)
+    indices = csr.indices.astype(np.int32)
+    values = csr.data.astype(np.float64)
+
+    ds = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(I32),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        values.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+        ctypes.c_int64(5), b"num_leaves=7 min_data_in_leaf=5 verbose=-1",
+        None, ctypes.byref(ds)))
+    _ok(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(400), ctypes.c_int(F32)))
+
+    # feature names round trip
+    names = [b"alpha", b"beta", b"gamma", b"delta", b"epsilon"]
+    arr_in = (ctypes.c_char_p * 5)(*names)
+    _ok(lib, lib.LGBM_DatasetSetFeatureNames(ds, arr_in, ctypes.c_int64(5)))
+    bufs = [ctypes.create_string_buffer(32) for _ in range(5)]
+    arr_out = (ctypes.c_char_p * 5)(*[ctypes.addressof(b) for b in bufs])
+    n_names = ctypes.c_int64()
+    _ok(lib, lib.LGBM_DatasetGetFeatureNames(ds, arr_out, ctypes.byref(n_names)))
+    assert [b.value for b in bufs] == names
+
+    # subset
+    idx = np.arange(0, 400, 2, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.c_void_p), ctypes.c_int32(len(idx)),
+        b"", ctypes.byref(sub)))
+    n = ctypes.c_int64()
+    _ok(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(n)))
+    assert n.value == 200
+
+    # booster with custom gradients (logistic), reset_parameter, predict CSR
+    params = b"objective=none num_leaves=7 min_data_in_leaf=5 verbose=-1"
+    bst = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_BoosterCreate(ds, params, ctypes.byref(bst)))
+    _ok(lib, lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.2"))
+    nlen = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterGetNumPredict(bst, 0, ctypes.byref(nlen)))
+    assert nlen.value == 400
+    fin = ctypes.c_int()
+    inner = (ctypes.c_double * 400)()
+    for _ in range(5):
+        _ok(lib, lib.LGBM_BoosterGetPredict(bst, 0, ctypes.byref(nlen), inner))
+        p = 1.0 / (1.0 + np.exp(-2.0 * np.asarray(list(inner))))
+        grad = (p - y).astype(np.float32)
+        hess = (2.0 * p * (1.0 - p)).astype(np.float32)
+        _ok(lib, lib.LGBM_BoosterUpdateOneIterCustom(
+            bst, grad.ctypes.data_as(ctypes.c_void_p),
+            hess.ctypes.data_as(ctypes.c_void_p), ctypes.byref(fin)))
+
+    want = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int64(400), ctypes.c_int(PRED_RAW), ctypes.c_int64(-1),
+        ctypes.byref(want)))
+    assert want.value == 400
+    pred_csr = (ctypes.c_double * 400)()
+    _ok(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(I32),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        values.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+        ctypes.c_int64(5), ctypes.c_int(PRED_RAW), ctypes.c_int64(-1),
+        ctypes.byref(nlen), pred_csr))
+    pred_mat = (ctypes.c_double * 400)()
+    _ok(lib, lib.LGBM_BoosterPredictForMat(
+        bst, np.ascontiguousarray(Xd).ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(F64), ctypes.c_int32(400), ctypes.c_int32(5),
+        ctypes.c_int(1), ctypes.c_int(PRED_RAW), ctypes.c_int64(-1),
+        ctypes.byref(nlen), pred_mat))
+    np.testing.assert_allclose(list(pred_csr), list(pred_mat), atol=1e-9)
+
+    # dump model json
+    out_len = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterDumpModel(bst, ctypes.c_int(-1), ctypes.c_int(0),
+                                       ctypes.byref(out_len), None))
+    buf = ctypes.create_string_buffer(out_len.value)
+    _ok(lib, lib.LGBM_BoosterDumpModel(bst, ctypes.c_int(-1),
+                                       ctypes.c_int(out_len.value),
+                                       ctypes.byref(out_len), buf))
+    import json
+    assert json.loads(buf.value.decode())["num_class"] == 1
+
+    # leaf get/set round trip (c_api.h:594-617)
+    val = ctypes.c_double()
+    _ok(lib, lib.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(val)))
+    _ok(lib, lib.LGBM_BoosterSetLeafValue(bst, 0, 0,
+                                          ctypes.c_double(val.value + 0.5)))
+    val2 = ctypes.c_double()
+    _ok(lib, lib.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(val2)))
+    assert abs(val2.value - val.value - 0.5) < 1e-6  # leaf storage is f32
+
+    # merge: a second booster's trees append
+    bst2 = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 min_data_in_leaf=5 verbose=-1",
+        ctypes.byref(bst2)))
+    _ok(lib, lib.LGBM_BoosterUpdateOneIter(bst2, ctypes.byref(fin)))
+    _ok(lib, lib.LGBM_BoosterMerge(bst2, bst))
+    it = ctypes.c_int64()
+    _ok(lib, lib.LGBM_BoosterGetCurrentIteration(bst2, ctypes.byref(it)))
+    assert it.value == 6  # 1 own + 5 merged
+
+    for h in (ds, sub):
         _ok(lib, lib.LGBM_DatasetFree(h))
     _ok(lib, lib.LGBM_BoosterFree(bst))
     _ok(lib, lib.LGBM_BoosterFree(bst2))
