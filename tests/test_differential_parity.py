@@ -172,3 +172,54 @@ def test_differential_lambdarank_metric_equivalence(ref_exe, tmp_path):
     n_ours = ndcg(ours.predict(Xr, raw_score=True))
     n_ref = ndcg(lgb.Booster(model_file=model).predict(Xr, raw_score=True))
     assert abs(n_ours - n_ref) < 5e-3, (n_ours, n_ref)
+
+
+@pytest.mark.parametrize(
+    "tag,mutate,extra",
+    [
+        ("nan", "nan", ()),                      # NaN cells (missing values)
+        ("maxbin16", None, ("max_bin=16",)),     # coarse binning
+        ("constcol", "const", ()),               # trivial 1-bin feature
+        ("intvals", "round3", ()),               # few distinct values
+        ("dupes", "half", ()),                   # heavy duplicate values
+        ("minhess", None, ("min_sum_hessian_in_leaf=5.0",)),
+    ],
+)
+def test_differential_edge_cases(ref_exe, tmp_path, tag, mutate, extra):
+    """Binning and constraint edge cases must track the reference:
+    NaN cells (treated as 0.0, bin.cpp NaN path), small max_bin, trivial
+    constant columns, discrete/duplicated value distributions, and the
+    min_sum_hessian constraint."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(5)
+    n = 1200
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    if mutate == "nan":
+        X[rng.rand(n, 5) < 0.15] = np.nan
+    elif mutate == "const":
+        X[:, 2] = 3.14
+    elif mutate == "round3":
+        X = np.round(X * 3)
+    elif mutate == "half":
+        X[:, 0] = np.round(X[:, 0] * 2) / 2
+    data = os.path.join(str(tmp_path), f"edge_{tag}.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.8g", delimiter=",")
+    X = np.loadtxt(data, delimiter=",")[:, 1:]
+    model = os.path.join(str(tmp_path), f"edge_{tag}_ref.txt")
+    conf = [f"data={data}", "task=train", "objective=binary", "num_trees=5",
+            "num_leaves=15", "min_data_in_leaf=10", f"output_model={model}",
+            "is_save_binary_file=false", "verbosity=-1"] + list(extra)
+    r = subprocess.run([ref_exe] + conf, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout[-300:] + r.stderr[-300:]
+    ref_pred = lgb.Booster(model_file=model).predict(X, raw_score=True)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
+              "verbose": -1}
+    for kv in extra:
+        k, v = kv.split("=")
+        params[k] = v
+    ours = lgb.train(params, lgb.Dataset(data), num_boost_round=5)
+    np.testing.assert_allclose(ours.predict(X, raw_score=True), ref_pred,
+                               atol=1e-5)
