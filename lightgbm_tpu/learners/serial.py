@@ -108,14 +108,24 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _hist_tiers(n: int):
-    """Static slice capacities for the smaller-child histogram: power-of
-    -two fractions of n, lane-aligned, ascending.  Includes a full-n
-    tier: under row sharding the LOCAL count of the globally-smaller
-    child can approach n_local (global balance says nothing about one
-    shard's split), so ceil(n/2) is not a guaranteed fit there."""
+    """Static slice capacities for the smaller-child histogram: fractions
+    of n, lane-aligned, ascending.  Includes a full-n tier: under row
+    sharding the LOCAL count of the globally-smaller child can approach
+    n_local (global balance says nothing about one shard's split), so
+    ceil(n/2) is not a guaranteed fit there.
+
+    LGBM_TPU_TIER_SPACING (read at TRACE time; default 2) sets the
+    geometric step between capacities: 2 wastes <2x gather work per
+    split but instantiates ~9 tier bodies (one Mosaic kernel compile
+    each on TPU); 4 halves the compile cost for <4x gather waste."""
+    import os
+
+    step = max(2, int(os.environ.get("LGBM_TPU_TIER_SPACING", "2")))
     caps = {max(512, _round_up(n, 128))}
-    for frac in (256, 128, 64, 32, 16, 8, 4, 2):
+    frac = 2
+    while frac <= 256:  # step=2 reproduces the original 2,4,...,256 set
         caps.add(max(512, _round_up(-(-n // frac), 128)))
+        frac *= step
     return tuple(sorted(caps))
 
 

@@ -77,6 +77,30 @@ def main():
                           f"FAILED: {type(e).__name__}: {str(e)[:300]}",
                           flush=True)
 
+    # gather-layout A/B: the leafwise smaller-child gather is currently a
+    # minor-dim column take of [F, n]; the alternative keeps a row-major
+    # copy and gathers rows (then relayouts [cap, F] -> [F, cap]).
+    bins_rm = jnp.asarray(np.ascontiguousarray(np.asarray(bins).T))  # [n, F]
+    for cap in (ROWS // 4, ROWS // 16):
+        idx = jnp.asarray(rng.randint(0, ROWS, cap).astype(np.int32))
+
+        @jax.jit
+        def take_cols(i):
+            return jnp.take(bins, i, axis=1)
+
+        @jax.jit
+        def take_rows_T(i):
+            return bins_rm[i].T
+
+        try:
+            ms_c = t(lambda: take_cols(idx))
+            ms_r = t(lambda: take_rows_T(idx))
+            print(f"gather cap={cap}: col-take {ms_c:.2f} ms, "
+                  f"row-take+T {ms_r:.2f} ms", flush=True)
+        except Exception as e:
+            print(f"gather cap={cap} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     # end-to-end growth modes (uses LGBM_TPU_HIST_KERNEL env default)
     import bench
     from lightgbm_tpu.config import Config
