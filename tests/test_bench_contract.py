@@ -1,0 +1,27 @@
+"""The driver contract for bench.py: whatever happens, stdout's last
+line is ONE JSON object with metric/value/unit/vs_baseline keys (the
+round-1 failure mode was an unhandled backend crash printing nothing)."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_always_emits_json_line():
+    env = dict(os.environ)
+    env.update(BENCH_ROWS="20000", BENCH_TREES="2", BENCH_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=ROOT,
+    )
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {r.stderr[-400:]}"
+    out = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline", "platform"):
+        assert key in out, out
+    assert out["unit"] == "s/tree"
+    assert out["value"] > 0, out
+    assert out["platform"] == "cpu"
