@@ -235,7 +235,9 @@ class Config:
     drop_seed: int = 4
 
     # ---- tree learner selection (config.cpp:324-335)
-    tree_learner: str = "serial"  # serial | feature | data | voting
+    tree_learner: str = "serial"  # serial | feature | data | voting |
+    # grid (TPU extension: rows x feature-search over a 2-D mesh)
+    grid_feature_shards: int = 2  # feature-axis width of the grid mesh
 
     # ---- network (NetworkConfig, config.h:223-231): on TPU the "machines"
     # are mesh devices; these remain accepted for config compatibility.
@@ -259,7 +261,7 @@ class Config:
     # -- derived flags (CheckParamConflict, config.cpp:136-175)
     @property
     def is_parallel(self) -> bool:
-        return self.tree_learner in ("feature", "data", "voting")
+        return self.tree_learner in ("feature", "data", "voting", "grid")
 
     @property
     def num_leaves_(self) -> int:
@@ -303,8 +305,14 @@ class Config:
 
     def _check_conflicts(self) -> None:
         """Mirror CheckParamConflict (config.cpp:136-175)."""
-        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+        if self.tree_learner not in (
+            "serial", "feature", "data", "voting", "grid"
+        ):
             raise ValueError(f"Unknown tree_learner: {self.tree_learner!r}")
+        if self.grid_feature_shards < 1:
+            raise ValueError(
+                f"grid_feature_shards must be >= 1, got {self.grid_feature_shards}"
+            )
         if self.boosting_type == "gbrt":  # accepted synonym (config.cpp:78)
             self.boosting_type = "gbdt"
         if self.boosting_type not in ("gbdt", "dart"):

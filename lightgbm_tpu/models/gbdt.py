@@ -182,6 +182,22 @@ class GBDT:
                 mesh, num_bins=self._num_bins, max_leaves=self.max_leaves,
                 sorted_hist=self._use_pallas_hist(),
             )
+        if tl == "grid":
+            from ..log import Log
+            from ..parallel import grid_mesh, make_grid_parallel_grower
+
+            c = max(1, min(int(self.config.grid_feature_shards), nd))
+            r = max(1, nd // c)
+            if r * c < nd:
+                Log.warning(
+                    f"grid mesh ({r}x{c}) uses {r * c} of {nd} devices; "
+                    "pick grid_feature_shards dividing the device count"
+                )
+            return make_grid_parallel_grower(
+                grid_mesh((r, c)), num_bins=self._num_bins,
+                max_leaves=self.max_leaves,
+                sorted_hist=self._use_pallas_hist(),
+            )
         if tl == "voting":
             return make_voting_parallel_grower(
                 mesh,

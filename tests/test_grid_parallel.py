@@ -1,0 +1,80 @@
+"""Grid-parallel (2-D rows x feature-search) learner == serial learner.
+
+The composition of the data-parallel histogram psum and the
+feature-parallel SplitInfo combine must preserve the reference's
+parallel==serial invariant on a 2x4 virtual device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.learners.serial import TreeLearnerParams, grow_tree
+from lightgbm_tpu.parallel.grid_parallel import (
+    grid_mesh,
+    make_grid_parallel_grower,
+)
+
+
+def _problem(n, F, B, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8)),
+        jnp.asarray(rng.randn(n).astype(np.float32)),
+        jnp.asarray((np.abs(rng.randn(n)) + 0.1).astype(np.float32)),
+        jnp.ones(n, jnp.float32),
+        jnp.ones(F, bool),
+        jnp.full(F, B, jnp.int32),
+        jnp.zeros(F, bool),
+    )
+
+
+@pytest.mark.parametrize("shape,n,F", [((2, 4), 1024, 12), ((4, 2), 999, 9)])
+def test_grid_matches_serial(shape, n, F):
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    B, L = 32, 15
+    args = _problem(n, F, B, seed=shape[0])
+    params = TreeLearnerParams.from_config(
+        Config(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+    )
+    t_ser, leaf_ser = grow_tree(*args, params, num_bins=B, max_leaves=L)
+    grow = make_grid_parallel_grower(
+        grid_mesh(shape), num_bins=B, max_leaves=L
+    )
+    t_grid, leaf_grid = grow(*args, params)
+
+    assert int(t_ser.num_leaves) == int(t_grid.num_leaves)
+    nl = int(t_ser.num_leaves)
+    assert nl > 2
+    for fname in ("split_feature", "threshold_bin", "decision_type"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_ser, fname))[: nl - 1],
+            np.asarray(getattr(t_grid, fname))[: nl - 1],
+            err_msg=fname,
+        )
+    np.testing.assert_allclose(
+        np.asarray(t_ser.leaf_value)[:nl],
+        np.asarray(t_grid.leaf_value)[:nl], rtol=2e-4,
+    )
+    np.testing.assert_array_equal(np.asarray(leaf_ser), np.asarray(leaf_grid))
+
+
+def test_grid_through_gbdt_end_to_end():
+    """tree_learner=grid through the full training API."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(1200, 10)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    serial = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbose": -1},
+        lgb.Dataset(X, label=y), num_boost_round=5)
+    grid = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "tree_learner": "grid", "grid_feature_shards": 4},
+        lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(
+        grid.predict(X, raw_score=True), serial.predict(X, raw_score=True),
+        atol=2e-4,
+    )
