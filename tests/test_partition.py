@@ -96,3 +96,41 @@ def test_leaf_assignment_covers_all_leaves():
     # every leaf the tree reports must own at least one (possibly OOB) row
     counts = np.bincount(leaf_id, minlength=nl)
     assert (counts > 0).all()
+
+
+def test_leaf_assignment_at_scale_with_bagging():
+    """Larger-n growth walks the deeper capacity tiers (the small cases
+    above only ever fit the 512-floor tier); validate the full partition
+    chain at 50k rows x 127 leaves under bagging against brute force."""
+    import numpy as np
+
+    rng = np.random.RandomState(42)
+    n, F, B, L = 50_000, 8, 64, 127
+    bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = (np.abs(rng.randn(n)) + 0.1).astype(np.float32)
+    bag = (rng.rand(n) < 0.8).astype(np.float32)
+    tree, leaf_id = grow_tree(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(bag), jnp.ones(F, bool), jnp.full(F, B, jnp.int32),
+        jnp.zeros(F, bool),
+        TreeLearnerParams.from_config(Config(min_data_in_leaf=50)),
+        num_bins=B, max_leaves=L,
+    )
+    nl = int(tree.num_leaves)
+    assert nl > L // 2
+    leaf_id = np.asarray(leaf_id)
+    sf = np.asarray(tree.split_feature)
+    tb = np.asarray(tree.threshold_bin)
+    lc = np.asarray(tree.left_child)
+    rc = np.asarray(tree.right_child)
+    node = np.zeros(n, np.int64)
+    for _ in range(64):
+        internal = node >= 0
+        if not internal.any():
+            break
+        idx = np.where(internal)[0]
+        v = bins[sf[node[idx]], idx]
+        go_left = v <= tb[node[idx]]
+        node[idx] = np.where(go_left, lc[node[idx]], rc[node[idx]])
+    np.testing.assert_array_equal(leaf_id, (~node).astype(np.int64))
