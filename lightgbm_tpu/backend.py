@@ -13,6 +13,7 @@ platform via ``jax.config`` — the only pinning that prevents the dial.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 
@@ -23,12 +24,37 @@ _PROBE_CODE = (
 )
 
 
+def _probe_interpreter() -> str | None:
+    """Path to a real python interpreter for the probe subprocess, or None.
+
+    In an embedded host (the plain-C path that src/capi/lgbm_capi.c
+    advertises) ``sys.executable`` is the host binary or empty; spawning
+    it with ``-c`` would re-execute the host program with arbitrary side
+    effects, or fail and wrongly pin CPU on a healthy TPU.
+    """
+    exe = sys.executable
+    if exe and os.path.basename(exe).lower().startswith("python"):
+        return exe
+    return None
+
+
 def default_backend_alive(timeout_s: float = 240.0, log=None) -> bool:
     """True iff the default JAX backend completes a tiny computation in a
-    subprocess within ``timeout_s``."""
+    subprocess within ``timeout_s``.
+
+    When no safe probe interpreter exists (embedded host), returns True
+    without probing: trusting the default backend is better than silently
+    pinning CPU, and such hosts can set LGBM_CAPI_PLATFORM for control.
+    """
+    exe = _probe_interpreter()
+    if exe is None:
+        if log is not None:
+            log("backend probe skipped: sys.executable is not a python "
+                "interpreter (embedded host); trusting default backend")
+        return True
     try:
         p = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE], timeout=timeout_s,
+            [exe, "-c", _PROBE_CODE], timeout=timeout_s,
             capture_output=True, text=True,
         )
         ok = p.returncode == 0 and "alive" in p.stdout

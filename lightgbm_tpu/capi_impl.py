@@ -25,12 +25,17 @@ if os.environ.get("LGBM_CAPI_PLATFORM"):
 
     jax.config.update("jax_platforms", os.environ["LGBM_CAPI_PLATFORM"])
 else:
-    # no explicit platform: probe the default backend with a timeout so a
+    # No explicit platform: probe the default backend with a timeout so a
     # dead TPU tunnel degrades to CPU instead of hanging the host process
-    # on its first LGBM_* call (see lightgbm_tpu.backend)
+    # on its first LGBM_* call (see lightgbm_tpu.backend).  NOTE: this can
+    # stall the first LGBM_* call for up to ~45s while the probe subprocess
+    # dials the backend; embedded hosts that want a fast, deterministic
+    # startup should set LGBM_CAPI_PLATFORM explicitly.  In hosts where
+    # sys.executable is not a python interpreter the probe is skipped and
+    # the default backend is trusted (lightgbm_tpu/backend.py).
     from .backend import pin_cpu_if_default_dead
 
-    pin_cpu_if_default_dead(timeout_s=120.0)
+    pin_cpu_if_default_dead(timeout_s=45.0)
 
 from .basic import Booster, Dataset, LightGBMError  # noqa: E402
 from .config import Config, key_alias_transform  # noqa: E402
@@ -182,7 +187,12 @@ def dataset_get_field(handle, field_name, out_len_addr, out_ptr_addr,
     if val is None:
         raise LightGBMError(f"field {field_name} is empty")
     if field_name in ("group", "query"):
-        arr = np.ascontiguousarray(val, dtype=np.int32)
+        # the reference C API returns query BOUNDARIES (len num_queries+1,
+        # dataset.cpp GetIntField -> query_boundaries_), not per-query sizes;
+        # its python wrapper diffs the boundaries back into sizes.  Internally
+        # we store sizes, so convert on the way out.
+        sizes = np.ascontiguousarray(val, dtype=np.int64)
+        arr = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32)
         out_type = _DTYPE_I32
     else:
         arr = np.ascontiguousarray(val, dtype=np.float32)

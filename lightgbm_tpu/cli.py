@@ -19,7 +19,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .config import Config, parse_config_file, parse_line_params
+from .config import (Config, key_alias_transform, parse_config_file,
+                     parse_line_params)
 from .io.dataset import BinnedDataset
 from .log import Log
 from .models.dart import create_boosting
@@ -30,13 +31,16 @@ from .objectives import create_objective
 def load_parameters(argv: List[str]) -> Dict[str, str]:
     """argv ``key=value`` pairs + optional config file; argv wins
     (application.cpp:46-104)."""
-    params = parse_line_params(argv)
-    conf_path = params.get("config", params.get("config_file", ""))
+    # Canonicalize alias keys BEFORE merging so argv wins across aliases
+    # too (argv ``valid=`` must override a conf-file ``valid_data=``),
+    # matching the reference's alias transform + priority merge
+    # (config.cpp Config::KV2Map / KeyAliasTransform).
+    params = key_alias_transform(parse_line_params(argv))
+    conf_path = params.pop("config_file", "")  # 'config' canonicalizes here
     if conf_path:
-        file_params = parse_config_file(conf_path)
+        file_params = key_alias_transform(parse_config_file(conf_path))
         for k, v in file_params.items():
             params.setdefault(k, v)
-    params.pop("config", None)
     params.pop("config_file", None)
     return params
 

@@ -14,6 +14,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Pin the embedded C API interpreter too (capi_impl import-time platform
+# selection) so test_c_api doesn't stall on a backend probe when the TPU
+# tunnel is dead.
+os.environ.setdefault("LGBM_CAPI_PLATFORM", "cpu")
 
 import jax  # noqa: E402
 

@@ -287,3 +287,39 @@ def test_c_api_extended_surface(lib, tmp_path):
         _ok(lib, lib.LGBM_DatasetFree(h))
     _ok(lib, lib.LGBM_BoosterFree(bst))
     _ok(lib, lib.LGBM_BoosterFree(bst2))
+
+
+def test_c_api_group_field_boundaries(lib):
+    """GetField('group') must return query BOUNDARIES (len num_queries+1),
+    matching the reference C API (dataset.cpp GetIntField hands out
+    query_boundaries_); the reference python wrapper diffs them back into
+    sizes.  SetField('group') takes per-query sizes, as in the reference."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(60, 4)
+    y = rng.rand(60).astype(np.float32)
+    flat = np.ascontiguousarray(X, dtype=np.float64)
+    ds = ctypes.c_void_p()
+    _ok(lib, lib.LGBM_DatasetCreateFromMat(
+        flat.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(F64),
+        ctypes.c_int32(60), ctypes.c_int32(4), ctypes.c_int(1),
+        b"min_data_in_leaf=2 verbose=-1", None, ctypes.byref(ds)))
+    _ok(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(60), ctypes.c_int(F32)))
+    sizes = np.array([10, 25, 5, 20], dtype=np.int32)
+    _ok(lib, lib.LGBM_DatasetSetField(
+        ds, b"group", sizes.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(4), ctypes.c_int(I32)))
+
+    out_len = ctypes.c_int64()
+    out_ptr = ctypes.c_void_p()
+    out_type = ctypes.c_int()
+    _ok(lib, lib.LGBM_DatasetGetField(
+        ds, b"group", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)))
+    assert out_type.value == I32
+    assert out_len.value == 5  # num_queries + 1 boundaries
+    bounds = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int32)), shape=(5,))
+    np.testing.assert_array_equal(bounds, [0, 10, 35, 40, 60])
+    _ok(lib, lib.LGBM_DatasetFree(ds))

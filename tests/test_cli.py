@@ -25,9 +25,13 @@ def test_load_parameters_precedence(tmp_path):
     conf = tmp_path / "t.conf"
     conf.write_text("num_trees = 100\nlearning_rate = 0.1\n# comment\n")
     params = load_parameters([f"config={conf}", "num_trees=7"])
-    assert params["num_trees"] == "7"  # argv wins (application.cpp:46-104)
+    # keys come back canonicalized; argv wins even across aliases
+    # (application.cpp:46-104 + config.cpp KeyAliasTransform)
+    assert params["num_iterations"] == "7"
     assert params["learning_rate"] == "0.1"
-    assert "config" not in params
+    assert "config" not in params and "config_file" not in params
+    cross = load_parameters([f"config={conf}", "num_iteration=9"])
+    assert cross["num_iterations"] == "9"
 
 
 def test_binary_train_and_predict_conf(in_example_dir, capsys):
