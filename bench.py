@@ -215,7 +215,10 @@ def _init_backend() -> str:
     return devs[0].platform
 
 
-def ours_sec_per_tree(X, y) -> tuple[float, float, str]:
+_DATASET_CACHE: dict = {}
+
+
+def ours_sec_per_tree(X, y, growth: str) -> tuple[float, float, str]:
     platform = _init_backend()
 
     from lightgbm_tpu.config import Config
@@ -224,22 +227,25 @@ def ours_sec_per_tree(X, y) -> tuple[float, float, str]:
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
 
-    # leaf-wise is BOTH the reference-parity growth (trees match the
-    # reference binary; depthwise trades ~0.01 AUC, BASELINE.md) and the
-    # TPU-fast mode: each split's histogram is one-hot MXU matmuls over
-    # the gathered smaller child (histogram_single_leaf).  On the CPU
-    # fallback there is no MXU and per-split kernels serialize, so the
-    # level-synchronous mode is the honest default there.
-    default_growth = "leafwise" if platform == "tpu" else "depthwise"
+    # leaf-wise is the HEADLINE growth mode on every platform: it is the
+    # reference-parity mode (trees match the reference binary; depthwise
+    # trades ~0.01 AUC, BASELINE.md) and on TPU also the fast mode (each
+    # split's histogram is one-hot MXU matmuls over the gathered smaller
+    # child).  Depthwise is reported as a secondary row only — a bench
+    # artifact must never advertise the approximate mode as the result.
     cfg = Config(
         objective="binary", num_leaves=NUM_LEAVES, max_bin=NUM_BINS,
         learning_rate=LEARNING_RATE, min_data_in_leaf=MIN_DATA,
         metric=["auc"],
-        tree_growth=os.environ.get("BENCH_GROWTH", default_growth),
+        tree_growth=growth,
     )
-    t0 = time.perf_counter()
-    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
-    log(f"binning: {time.perf_counter() - t0:.1f}s")
+    if "ds" in _DATASET_CACHE:
+        ds = _DATASET_CACHE["ds"]
+    else:
+        t0 = time.perf_counter()
+        ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+        log(f"binning: {time.perf_counter() - t0:.1f}s")
+        _DATASET_CACHE["ds"] = ds
     obj = create_objective(cfg, ds.metadata, ds.num_data)
     booster = GBDT(cfg, ds, obj)
 
@@ -256,13 +262,12 @@ def ours_sec_per_tree(X, y) -> tuple[float, float, str]:
         if not booster._use_matmul_hist():
             raise
         log(f"warmup failed ({type(e).__name__}: {str(e)[:300]}); "
-            "retrying with depthwise + hist_impl=segment")
-        # the known-good fallback: level-synchronous growth over
-        # segment_sum histograms (measured end-to-end on the chip);
-        # leafwise + segment does one scatter pass per SPLIT and is far
-        # slower than either Pallas mode
+            "retrying with hist_impl=segment (same growth mode)")
+        # known-good fallback: segment_sum histograms.  The growth mode is
+        # kept — the headline must stay the parity mode even when slow;
+        # an artifact that silently swaps in the approximate mode is worse
+        # than a slow honest number.
         cfg.hist_impl = "segment"
-        cfg.tree_growth = "depthwise"
         booster = GBDT(cfg, ds, obj)
         booster.train_one_iter()
         _ = np.asarray(booster._scores)
@@ -296,9 +301,11 @@ def main() -> None:
     }
     try:
         X, y = make_data(ROWS)
-        ours, auc, platform = ours_sec_per_tree(X, y)
+        growth = os.environ.get("BENCH_GROWTH", "leafwise")
+        ours, auc, platform = ours_sec_per_tree(X, y, growth)
         out["value"] = round(ours, 4)
         out["platform"] = platform
+        out["growth"] = growth
         out["train_auc"] = round(float(auc), 4)
         ref, ref_auc = reference_sec_per_tree(X, y, key)
         if ref and ours > 0:
@@ -306,6 +313,15 @@ def main() -> None:
         if ref_auc is not None:
             out["ref_auc"] = round(float(ref_auc), 4)
             out["auc_gap"] = round(abs(float(ref_auc) - out["train_auc"]), 4)
+        if os.environ.get("BENCH_SECONDARY", "0") != "0":
+            # optional secondary row: the level-synchronous approximation
+            sec, sec_auc, _ = ours_sec_per_tree(X, y, "depthwise")
+            out["secondary"] = {
+                "growth": "depthwise", "value": round(sec, 4),
+                "train_auc": round(float(sec_auc), 4),
+            }
+            if ref and sec > 0:
+                out["secondary"]["vs_baseline"] = round(ref / sec, 3)
     except Exception as e:
         import traceback
         traceback.print_exc(file=sys.stderr)

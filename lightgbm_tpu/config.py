@@ -218,6 +218,12 @@ class Config:
     # makes parallel == serial trees bit-identical) at the cost of
     # emulated f64 on TPU hardware.
     hist_dtype: str = "float32"  # float32 | float64
+    # Histogram HBM bound in MB (config.h:178, serial_tree_learner.cpp:
+    # 25-37): <= 0 keeps every leaf's histogram resident; otherwise the
+    # learner keeps floor(MB / per-leaf-histogram-MB) LRU slots (clamped
+    # to [2, num_leaves]) and recomputes evicted parents from their
+    # contiguous partition range.
+    histogram_pool_size: float = -1.0
 
     # ---- boosting (BoostingConfig, config.h:192-221)
     boosting_type: str = "gbdt"

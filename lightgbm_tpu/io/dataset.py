@@ -103,10 +103,10 @@ def _resolve_roles(config: Config, names: Optional[List[str]]):
     label_col = _resolve_column(config.label_column, names)
     if label_col is None:
         label_col = 0
-    ignore = set(_resolve_column_list(config.ignore_column, names))
-    cats = _resolve_column_list(config.categorical_column, names)
-    weight_col = _resolve_column(config.weight_column, names)
-    group_col = _resolve_column(config.group_column, names)
+    ignore = set(_resolve_column_list(config.ignore_column, names, label_col))
+    cats = _resolve_column_list(config.categorical_column, names, label_col)
+    weight_col = _resolve_column(config.weight_column, names, label_col)
+    group_col = _resolve_column(config.group_column, names, label_col)
     if weight_col is not None:
         ignore.add(weight_col)
     if group_col is not None:
@@ -130,26 +130,43 @@ def _merge_api_categoricals(cat_inner, categorical_features, num_features):
     return sorted(set(cat_inner) | {int(c) for c in categorical_features})
 
 
-def _resolve_column(spec: str, names: Optional[List[str]]) -> Optional[int]:
-    """Resolve 'name:foo' or integer-string column spec to an index
-    (dataset_loader.cpp:23-160)."""
+def _resolve_column(spec: str, names: Optional[List[str]],
+                    label_col: Optional[int] = None) -> Optional[int]:
+    """Resolve 'name:foo' or integer-string column spec to a RAW column
+    index (dataset_loader.cpp:23-160).
+
+    Numeric side-column specs (weight/group/ignore/categorical) are
+    FEATURE-space in the reference — its parser strips the label before
+    assigning indices (parser.hpp:28-33, ``bias = -1``), and name lookups
+    go through a label-removed name2idx (dataset_loader.cpp:62-67).  Pass
+    ``label_col`` to convert such a spec to raw space; the label spec
+    itself resolves raw (``label_col=None``)."""
     if spec is None or spec == "":
         return None
     if spec.startswith("name:"):
         if names is None:
             raise ValueError("column given by name but data has no header")
         return names.index(spec[5:])
-    return int(spec)
+    v = int(spec)
+    if label_col is not None and v >= label_col:
+        v += 1
+    return v
 
 
-def _resolve_column_list(spec: str, names: Optional[List[str]]) -> List[int]:
+def _resolve_column_list(spec: str, names: Optional[List[str]],
+                         label_col: Optional[int] = None) -> List[int]:
+    """List form of :func:`_resolve_column` (same feature-space
+    semantics for numeric entries when ``label_col`` is given)."""
     if not spec:
         return []
     if spec.startswith("name:"):
         if names is None:
             raise ValueError("columns given by name but data has no header")
         return [names.index(s) for s in spec[5:].split(",")]
-    return [int(s) for s in spec.replace(",", " ").split()]
+    out = [int(s) for s in spec.replace(",", " ").split()]
+    if label_col is not None:
+        out = [v if v < label_col else v + 1 for v in out]
+    return out
 
 
 class BinnedDataset:
@@ -667,10 +684,10 @@ class BinnedDataset:
         the round-1 dense-f64 materialization (a news20-scale memory
         bomb; reference handles this via SparseBin, sparse_bin.hpp).
 
-        Column-space note: in the dense parse the label occupies column 0
-        and token index ``t`` lands at raw column ``t+1``; sparse keeps
-        token indices as feature indices, so raw-space ``ignore_column``/
-        ``categorical_column`` entries shift down by one.
+        Column-space note: ``ignore_column``/``categorical_column``
+        numeric specs are FEATURE indices (the reference's parsers emit
+        label-removed indices, parser.hpp:28-33; LibSVM token indices ARE
+        feature indices), so they apply to the CSR columns directly.
         """
         from .sparse import _ranges_concat, parse_libsvm_csr
 
@@ -680,11 +697,7 @@ class BinnedDataset:
         side = Metadata.load_side_files(path)
         n = len(label)
 
-        ignore = {
-            j - 1
-            for j in _resolve_column_list(config.ignore_column, None)
-            if j >= 1
-        }
+        ignore = set(_resolve_column_list(config.ignore_column, None))
         if ignore:
             keep = ~np.isin(indices, np.asarray(sorted(ignore)))
             rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
@@ -692,11 +705,7 @@ class BinnedDataset:
             row_lens = np.bincount(rows, minlength=n)
             indptr = np.concatenate([[0], np.cumsum(row_lens, dtype=np.int64)])
         cats = _merge_api_categoricals(
-            [
-                j - 1
-                for j in _resolve_column_list(config.categorical_column, None)
-                if j >= 1
-            ],
+            _resolve_column_list(config.categorical_column, None),
             categorical_features, num_cols,
         )
         meta = Metadata(

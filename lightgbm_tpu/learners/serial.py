@@ -74,7 +74,11 @@ class _GrowState(NamedTuple):
     order: jax.Array  # [n + max_cap] leaf-sorted row permutation (pad = n)
     leaf_begin: jax.Array  # [L] int32 range start per leaf (order-space)
     pos_cnt: jax.Array  # [L] int32 positional count per leaf (incl. OOB rows)
-    hists: jax.Array  # [L, F, B, 3]
+    gate_cnt: jax.Array  # [L] int32 cross-shard MAX of pos_cnt (tier gates)
+    hists: jax.Array  # [L, F, B, 3] resident, or [P, F, B, 3] pooled
+    slot_of: jax.Array  # [L] int32 pool slot per leaf, -1 = evicted ([0] off)
+    slot_leaf: jax.Array  # [P] int32 leaf occupying each slot, -1 = free
+    slot_last: jax.Array  # [P] int32 last-use step per slot, -1 = free
     sum_g: jax.Array  # [L]
     sum_h: jax.Array  # [L]
     cnt: jax.Array  # [L]
@@ -225,7 +229,7 @@ def default_search_fn(
     jax.jit,
     static_argnames=(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
-        "reduce_max_fn",
+        "reduce_max_fn", "child_counts_fn", "search2_fn", "hist_pool",
     ),
 )
 def grow_tree(
@@ -243,6 +247,9 @@ def grow_tree(
     reduce_fn=None,
     search_fn=None,
     reduce_max_fn=None,
+    child_counts_fn=None,
+    search2_fn=None,
+    hist_pool: int = 0,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -251,10 +258,32 @@ def grow_tree(
     default is the local kernel.  ``reduce_fn`` (cross-device sum) is
     applied to the root (Σg, Σh, count) scalars — the analog of the
     data-parallel learner's tree-start allreduce
-    (data_parallel_tree_learner.cpp:97-125).  ``reduce_max_fn``
-    (cross-device max) makes the static-capacity tier gates uniform
-    across row shards whose local leaf sizes differ; both default to
-    local values on a single device.
+    (data_parallel_tree_learner.cpp:97-125).
+
+    Per-split cross-device traffic is concentrated in two hooks so a
+    parallel learner pays the minimum collective count per split:
+
+    * ``child_counts_fn(nleft, nright) -> (sum_l, sum_r, max_l, max_r)``
+      reduces the two children's LOCAL positional counts once — the sums
+      pick the globally smaller child (whose histogram partials the mesh
+      reduces), the maxes feed the static-capacity tier gates of BOTH
+      later splits of these leaves (stored in ``state.gate_cnt``, so no
+      per-split pmax is needed at consume time).  Default: local values
+      through ``reduce_fn``/``reduce_max_fn`` when given, else identity.
+    * ``search2_fn(h_left, h_right, lsg, lsh, lc, rsg, rsh, rc, can,
+      feature_mask, nbpf, is_cat, params) -> (SplitResult, SplitResult)``
+      searches BOTH children in one go so a sharded-search learner can
+      combine the two results in a single all_gather.  Default: two
+      ``search_fn`` calls.
+
+    ``hist_pool`` bounds histogram HBM: when ``2 <= hist_pool <
+    max_leaves`` only that many leaf histograms stay resident
+    (``[P, F, B, 3]``) under an LRU policy, and a split whose parent was
+    evicted RECOMPUTES the parent histogram from the leaf's contiguous
+    ``order`` range — the reference's HistogramPool
+    (feature_histogram.hpp:337-481, serial_tree_learner.cpp:25-32)
+    re-cast for static shapes.  ``0`` (default) keeps every leaf
+    resident.
     """
     F, n = bins_T.shape
     L = max_leaves
@@ -266,7 +295,12 @@ def grow_tree(
         hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
     if search_fn is None:
         search_fn = default_search_fn
-    gate = (lambda c: c) if reduce_max_fn is None else reduce_max_fn
+    if child_counts_fn is None:
+        _sum = (lambda x: x) if reduce_fn is None else reduce_fn
+        _max = (lambda x: x) if reduce_max_fn is None else reduce_max_fn
+
+        def child_counts_fn(nl, nr):
+            return _sum(nl), _sum(nr), _max(nl), _max(nr)
 
     def best_for(hist, sg, sh, c, depth_child):
         can = (params.max_depth <= 0) | (depth_child < params.max_depth)
@@ -275,19 +309,39 @@ def grow_tree(
             feature_mask, num_bins_per_feature, is_categorical, params,
         )
 
+    def best2_for(hl, hr, lsg, lsh, lc, rsg, rsh, rc, depth_child):
+        can = (params.max_depth <= 0) | (depth_child < params.max_depth)
+        if search2_fn is not None:
+            return search2_fn(
+                hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
+                feature_mask, num_bins_per_feature, is_categorical, params,
+            )
+        return (
+            search_fn(hl, lsg, lsh, lc, can,
+                      feature_mask, num_bins_per_feature, is_categorical,
+                      params),
+            search_fn(hr, rsg, rsh, rc, can,
+                      feature_mask, num_bins_per_feature, is_categorical,
+                      params),
+        )
+
     # ---- root (BeforeTrain / LeafSplits::Init, leaf_splits.hpp:51-92)
     hist0 = hist_fn(bins_T, grad, hess, bag_mask)
     sum_g0 = jnp.sum(grad * bag_mask)
     sum_h0 = jnp.sum(hess * bag_mask)
     cnt0 = jnp.sum(bag_mask)
     if reduce_fn is not None:
-        sum_g0, sum_h0, cnt0 = reduce_fn(sum_g0), reduce_fn(sum_h0), reduce_fn(cnt0)
+        # one stacked collective for the tree-start allreduce
+        s = reduce_fn(jnp.stack([sum_g0, sum_h0, cnt0]))
+        sum_g0, sum_h0, cnt0 = s[0], s[1], s[2]
 
     # hist0's feature extent may be a shard of F (feature-parallel
     # learner); accumulation dtype follows grad/hess — float64 when
     # Config.hist_dtype asks for the reference's double accumulation
     # (include/LightGBM/bin.h:21-22)
     acc_dt = hist0.dtype
+    pooled = 0 < hist_pool < L
+    P = max(hist_pool, 2) if pooled else L
     state = _GrowState(
         order=jnp.concatenate(
             [
@@ -297,7 +351,15 @@ def grow_tree(
         ),
         leaf_begin=jnp.zeros(L, jnp.int32),
         pos_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
-        hists=jnp.zeros((L,) + hist0.shape, acc_dt).at[0].set(hist0),
+        # root gate: every shard's padded local row count is the same n
+        gate_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
+        hists=jnp.zeros((P,) + hist0.shape, acc_dt).at[0].set(hist0),
+        slot_of=(jnp.full(L, -1, jnp.int32).at[0].set(0) if pooled
+                 else jnp.zeros(0, jnp.int32)),
+        slot_leaf=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
+                   else jnp.zeros(0, jnp.int32)),
+        slot_last=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
+                   else jnp.zeros(0, jnp.int32)),
         sum_g=jnp.zeros(L, acc_dt).at[0].set(sum_g0),
         sum_h=jnp.zeros(L, acc_dt).at[0].set(sum_h0),
         cnt=jnp.zeros(L, acc_dt).at[0].set(cnt0),
@@ -325,12 +387,15 @@ def grow_tree(
         thr = state.best.threshold[best_leaf]
         is_cat = is_categorical[jnp.maximum(f, 0)]
 
-        # ---- partition the parent's range in place (DataPartition::Split)
+        # ---- partition the parent's range in place (DataPartition::Split).
+        # The tier gate (cross-shard max of the parent's positional count)
+        # was stored at the split that CREATED this leaf — no collective
+        # here.
         begin = state.leaf_begin[best_leaf]
         pcnt = state.pos_cnt[best_leaf]
         order, nleft = _tier_chain(
             p_tiers,
-            gate(pcnt),
+            state.gate_cnt[best_leaf],
             lambda cap: _partition_branch(
                 state.order, bins_T, f, thr, is_cat, begin, pcnt, do_split, cap
             ),
@@ -356,25 +421,71 @@ def grow_tree(
         # ---- smaller-child histogram from its contiguous range; sibling
         # by subtraction.  "Smaller" is by POSITIONAL count (the work the
         # gather actually does) — reduced across row shards: every shard
-        # must pick the SAME child (the psum inside the hist branch sums
-        # one child's partials), even though local counts differ.  The
-        # tier gate must likewise be uniform, hence gate() (pmax).
-        nleft_g, nright_g = nleft, nright
-        if reduce_fn is not None:
-            nleft_g, nright_g = reduce_fn(nleft), reduce_fn(nright)
+        # must pick the SAME child (the cross-shard reduction inside the
+        # hist branch sums one child's partials), even though local counts
+        # differ.  ONE child_counts_fn call yields both the global sums
+        # (child choice) and the cross-shard maxes (tier gates for this
+        # split's histogram AND both children's later partitions).
+        nleft_g, nright_g, nleft_gate, nright_gate = child_counts_fn(
+            nleft, nright
+        )
+        gate_cnt = (
+            state.gate_cnt.at[best_leaf]
+            .set(jnp.where(do_split, nleft_gate, state.gate_cnt[best_leaf]))
+            .at[new_leaf]
+            .set(jnp.where(do_split, nright_gate, state.gate_cnt[new_leaf]))
+        )
         small_is_left = nleft_g <= nright_g
         cnt_s = jnp.where(small_is_left, nleft, nright)
+        cnt_s_gate = jnp.where(small_is_left, nleft_gate, nright_gate)
         begin_s = jnp.where(small_is_left, begin, begin + nleft)
         h_small = _tier_chain(
             h_tiers,
-            gate(cnt_s),
+            cnt_s_gate,
             lambda cap: _child_hist_branch(
                 hist_fn, order, bins_T, grad, hess, bag_mask,
                 begin_s, cnt_s, cap,
             ),
         )
-        h_parent = state.hists[best_leaf]
-        h_prev_new = state.hists[new_leaf]
+        if pooled:
+            # ---- HistogramPool residency (feature_histogram.hpp:337-481):
+            # the parent's histogram may have been LRU-evicted since the
+            # split that computed it; recompute it from the leaf's
+            # contiguous order range then (same O(|parent|) gather as a
+            # child histogram — the range holds exactly the parent's rows,
+            # partition order does not change the histogram).  The
+            # residency flag is uniform across shards (slot state is
+            # deterministic), so collectives inside the cond are safe.
+            ps = state.slot_of[best_leaf]
+            resident = ps >= 0
+            h_parent = jax.lax.cond(
+                resident,
+                lambda _: state.hists[jnp.maximum(ps, 0)],
+                lambda _: _tier_chain(
+                    h_tiers,
+                    state.gate_cnt[best_leaf],
+                    lambda cap: _child_hist_branch(
+                        hist_fn, order, bins_T, grad, hess, bag_mask,
+                        begin, pcnt, cap,
+                    ),
+                ).astype(acc_dt),
+                None,
+            )
+            # LRU slot choice: overwrite the parent's slot for the left
+            # child when resident; otherwise the least-recently-used slot
+            # (free slots carry last-use -1 and win argmin).  The right
+            # child takes the LRU slot excluding s1.
+            s1 = jnp.where(
+                resident, ps, jnp.argmin(state.slot_last).astype(jnp.int32)
+            )
+            idxP = jnp.arange(P, dtype=jnp.int32)
+            s2 = jnp.argmin(
+                jnp.where(idxP == s1, jnp.int32(2**30), state.slot_last)
+            ).astype(jnp.int32)
+            h_prev_new = state.hists[s2]
+        else:
+            h_parent = state.hists[best_leaf]
+            h_prev_new = state.hists[new_leaf]
         h_large = h_parent - h_small
         h_left = jnp.where(small_is_left, h_small, h_large)
         h_right = jnp.where(small_is_left, h_large, h_small)
@@ -383,8 +494,9 @@ def grow_tree(
         # leaves) — computed BEFORE the buffer update so that every read
         # of state.hists is finished by then (see barrier below)
         depth_child = t.leaf_depth[best_leaf] + 1
-        best_l_new = best_for(h_left, lsg, lsh, lc, depth_child)
-        best_r_new = best_for(h_right, rsg, rsh, rc, depth_child)
+        best_l_new, best_r_new = best2_for(
+            h_left, h_right, lsg, lsh, lc, rsg, rsh, rc, depth_child
+        )
 
         # ---- in-place buffer update.  Everything derived from reads of
         # state.hists (the stacked new rows and the child searches) goes
@@ -393,18 +505,50 @@ def grow_tree(
         # so XLA's copy insertion lets the two-row scatter update it in
         # place.  (Without this, the compiled while body copied the full
         # [L, F, B, 3] buffer twice per split — measured in the HLO.)
-        new_rows = jnp.stack(
-            [
-                jnp.where(do_split, h_left, h_parent),
-                jnp.where(do_split, h_right, h_prev_new),
-            ]
-        )
+        if pooled:
+            # preserve the slots' old contents when the step no-ops
+            new_rows = jnp.stack(
+                [
+                    jnp.where(do_split, h_left, state.hists[s1]),
+                    jnp.where(do_split, h_right, h_prev_new),
+                ]
+            )
+            rows_idx = jnp.stack([s1, s2])
+        else:
+            new_rows = jnp.stack(
+                [
+                    jnp.where(do_split, h_left, h_parent),
+                    jnp.where(do_split, h_right, h_prev_new),
+                ]
+            )
+            rows_idx = jnp.stack([best_leaf, new_leaf])
         new_rows, best_l_new, best_r_new, hists_in = jax.lax.optimization_barrier(
             (new_rows, best_l_new, best_r_new, state.hists)
         )
-        hists = hists_in.at[jnp.stack([best_leaf, new_leaf])].set(
-            new_rows, unique_indices=True
-        )
+        hists = hists_in.at[rows_idx].set(new_rows, unique_indices=True)
+
+        if pooled:
+            # residency bookkeeping, all masked on do_split: evicted
+            # occupants lose their slot, then the two children claim
+            # s1/s2 (ORDER MATTERS: the parent may be its own evictee)
+            def mi(arr, i, val):
+                return arr.at[i].set(
+                    jnp.where(do_split, val, arr[i]).astype(arr.dtype)
+                )
+
+            e1, e2 = state.slot_leaf[s1], state.slot_leaf[s2]
+            slot_of = state.slot_of
+            slot_of = mi(slot_of, jnp.maximum(e1, 0),
+                         jnp.where(e1 >= 0, -1, slot_of[jnp.maximum(e1, 0)]))
+            slot_of = mi(slot_of, jnp.maximum(e2, 0),
+                         jnp.where(e2 >= 0, -1, slot_of[jnp.maximum(e2, 0)]))
+            slot_of = mi(mi(slot_of, best_leaf, s1), new_leaf, s2)
+            slot_leaf = mi(mi(state.slot_leaf, s1, best_leaf), s2, new_leaf)
+            slot_last = mi(mi(state.slot_last, s1, step), s2, step)
+        else:
+            slot_of = state.slot_of
+            slot_leaf = state.slot_leaf
+            slot_last = state.slot_last
 
         # ---- tree bookkeeping (Tree::Split, tree.cpp:52-96)
         parent = t.leaf_parent[best_leaf]
@@ -468,7 +612,11 @@ def grow_tree(
             order=order,
             leaf_begin=leaf_begin,
             pos_cnt=pos_cnt,
+            gate_cnt=gate_cnt,
             hists=hists,
+            slot_of=slot_of,
+            slot_leaf=slot_leaf,
+            slot_last=slot_last,
             sum_g=m(m(state.sum_g, best_leaf, lsg), new_leaf, rsg),
             sum_h=m(m(state.sum_h, best_leaf, lsh), new_leaf, rsh),
             cnt=m(m(state.cnt, best_leaf, lc), new_leaf, rc),

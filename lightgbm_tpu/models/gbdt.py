@@ -149,6 +149,7 @@ class GBDT:
                 max_leaves=self.max_leaves,
                 growth=self.config.tree_growth,
                 sorted_hist=self._use_pallas_hist(),
+                hist_pool=self._hist_pool_slots(),
             )
         if tl == "serial" or len(jax.devices()) == 1:
             if self.config.tree_growth == "depthwise":
@@ -165,6 +166,7 @@ class GBDT:
                 num_bins=self._num_bins,
                 max_leaves=self.max_leaves,
                 hist_fn=self._leafwise_hist_fn(),
+                hist_pool=self._hist_pool_slots(),
             )
         from ..parallel import (
             data_mesh,
@@ -181,6 +183,7 @@ class GBDT:
             return make_feature_parallel_grower(
                 mesh, num_bins=self._num_bins, max_leaves=self.max_leaves,
                 sorted_hist=self._use_pallas_hist(),
+                hist_pool=self._hist_pool_slots(),
             )
         if tl == "grid":
             from ..log import Log
@@ -197,6 +200,7 @@ class GBDT:
                 grid_mesh((r, c)), num_bins=self._num_bins,
                 max_leaves=self.max_leaves,
                 sorted_hist=self._use_pallas_hist(),
+                hist_pool=self._hist_pool_slots(),
             )
         if tl == "voting":
             return make_voting_parallel_grower(
@@ -205,6 +209,7 @@ class GBDT:
                 max_leaves=self.max_leaves,
                 top_k=self.config.top_k,
                 sorted_hist=self._use_pallas_hist(),
+                hist_pool=self._hist_pool_slots(),
             )
         return make_data_parallel_grower(
             mesh,
@@ -212,7 +217,31 @@ class GBDT:
             max_leaves=self.max_leaves,
             growth=self.config.tree_growth,
             sorted_hist=self._use_pallas_hist(),
+            hist_pool=self._hist_pool_slots(),
         )
+
+    def _hist_pool_slots(self) -> int:
+        """config.histogram_pool_size (MB) -> LRU slot count, the
+        reference's sizing rule (serial_tree_learner.cpp:25-37): 0 means
+        keep all num_leaves histograms resident.  Applies to every
+        leaf-wise learner (serial and all mesh variants); depth-wise
+        growth builds transient per-level histograms instead of a
+        resident per-leaf buffer, so the bound is moot there."""
+        mb = float(self.config.histogram_pool_size)
+        if mb <= 0:
+            return 0
+        if self.config.tree_growth == "depthwise":
+            from ..log import Log
+
+            Log.warning(
+                "histogram_pool_size is ignored for tree_growth=depthwise "
+                "(per-level histograms are transient, not leaf-resident)"
+            )
+            return 0
+        itemsize = 8 if self._use_f64_hist else 4
+        per_leaf = int(self._bins_T.shape[0]) * self._num_bins * 3 * itemsize
+        slots = int(mb * 1024 * 1024 / max(per_leaf, 1))
+        return max(2, min(slots, self.max_leaves))
 
     def _use_matmul_hist(self) -> bool:
         impl = self.config.hist_impl

@@ -6,23 +6,38 @@ TPU-native re-design of DataParallelTreeLearner
 * rows are sharded over the mesh's row axis — the analog of the
   per-machine row partition at load (dataset_loader.cpp:500-605);
 * each shard builds local histograms for ALL features, then a single
-  `psum` replaces the reference's recursive-halving ReduceScatter +
-  Bruck Allgather of histogram blocks (data_parallel_tree_learner.cpp:
-  127-157, network.cpp:99-185).  Because every device then holds the
-  GLOBAL histogram, the best-split argmax is computed redundantly but
-  identically on all shards, which also subsumes the reference's
-  Allreduce(SplitInfo, MaxReducer) step (data_parallel_tree_learner.cpp:
-  192-227) — no candidate exchange is needed at all;
+  `psum_scatter` over the FEATURE axis hands every device its feature
+  shard of the GLOBAL histogram — the same reduce-scatter-of-histogram-
+  blocks pattern as the reference's recursive-halving ReduceScatter
+  (data_parallel_tree_learner.cpp:127-157, network.cpp:99-185), at half
+  an allreduce's comm volume.  Each device searches only its own shard
+  and the winners meet in an all_gather + deterministic max — the
+  reference's Allreduce(SplitInfo, MaxReducer)
+  (data_parallel_tree_learner.cpp:192-227);
 * the root (Σg, Σh, n) allreduce at tree start
   (data_parallel_tree_learner.cpp:97-125) is the `reduce_fn` psum hook;
 * the leaf partition stays fully local to each shard (leaf ids are
   global indices), mirroring the local DataPartition with global leaf
   counts (data_parallel_tree_learner.cpp:229-235).
 
-Because psum delivers bit-identical sums on every participant, parallel
-trees match serial trees up to float reduction order — the reference's
-parallel==serial invariant (split_info.hpp:98-103 tie-break) holds
-structurally by construction.
+Per-SPLIT collective budget of the leaf-wise learner (the reference pays
+one reduce-scatter + one SplitInfo allreduce per LEVEL):
+
+1. one all_gather of the two children's local positional counts [2]
+   (child choice by global sum + tier gates by cross-shard max — both
+   derived locally from the gathered vector);
+2. one psum_scatter of the smaller child's [F, B, 3] histogram partials;
+3. one all_gather of the two children's per-shard best SplitInfos
+   (stacked — a single collective for both searches).
+
+Per-device histogram residency shrinks to ``[L, F/D, B, 3]`` — the mesh
+is also a histogram-memory shard (cf. HistogramPool,
+feature_histogram.hpp:337-481).
+
+Determinism: psum_scatter sums the same D partials as psum (reduction
+order may differ from serial by association only), and the SplitInfo
+combine reproduces split_info.hpp:98-103 tie-breaks, so parallel trees
+match serial trees up to float reduction order.
 """
 
 from __future__ import annotations
@@ -36,18 +51,24 @@ from jax.sharding import PartitionSpec as P
 from ..learners.depthwise import grow_tree_depthwise
 from ..learners.serial import grow_tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
+from ..ops.split import SplitResult, find_best_split
 from .mesh import ROW_AXIS, row_padded_grower
+from .split_comm import (combine_gathered_split_infos, gather_and_combine,
+                         pack_split, unpack_split)
 
 
 def data_parallel_sharded(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
     growth: str = "leafwise", sorted_hist: bool = False,
+    hist_pool: int = 0,
 ):
     """The raw shard-mapped grow fn over ``mesh`` (rows sharded on
     ``axis``).  Callers are responsible for row padding / global-array
     plumbing: use :func:`make_data_parallel_grower` single-host and
     multihost.make_multihost_data_parallel_grower across processes."""
     from ..ops.histogram import select_single_hist_fn
+
+    num_shards = mesh.shape[axis]
 
     # per-shard kernels: leaf-wise per-split histogram over the gathered
     # smaller child, and the depthwise per-level leaf-sorted variant
@@ -63,9 +84,6 @@ def data_parallel_sharded(
                 num_bins=num_bins, num_leaves=num_leaves,
             )
 
-    def hist_psum(bins_T, grad, hess, mask):
-        return jax.lax.psum(hist_local(bins_T, grad, hess, mask), axis)
-
     def level_hist_psum(bins_T, leaf_id, grad, hess, mask, num_leaves):
         return jax.lax.psum(
             local_level_hist(bins_T, leaf_id, grad, hess, mask, num_leaves),
@@ -75,12 +93,6 @@ def data_parallel_sharded(
     def reduce_sum(x):
         return jax.lax.psum(x, axis)
 
-    def reduce_max(x):
-        # tier-gate uniformity: local leaf sizes differ per row shard, but
-        # the static slice capacity (a lax.cond branch containing psums)
-        # must be chosen identically everywhere
-        return jax.lax.pmax(x, axis)
-
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
         if growth == "depthwise":
             return grow_tree_depthwise(
@@ -88,6 +100,66 @@ def data_parallel_sharded(
                 num_bins=num_bins, max_leaves=max_leaves,
                 hist_fn=level_hist_psum,
             )
+
+        F = bins_T.shape[0]
+        Fs = -(-F // num_shards)  # feature-shard width of the scattered hist
+        pad = Fs * num_shards - F
+        fmask_p = jnp.pad(fmask, (0, pad))  # padding: unusable features
+        nbpf_p = jnp.pad(nbpf, (0, pad), constant_values=1)
+        iscat_p = jnp.pad(is_cat, (0, pad))
+        start = jax.lax.axis_index(axis) * Fs
+
+        def local(a):
+            return jax.lax.dynamic_slice_in_dim(a, start, Fs, axis=0)
+
+        def hist_scatter(bins_arg, g, h, m):
+            # local full-feature partials -> reduce-scatter feature blocks:
+            # this device leaves owning the GLOBAL histogram of features
+            # [start, start+Fs) only (data_parallel_tree_learner.cpp:
+            # 127-157)
+            hp = hist_local(bins_arg, g, h, m)
+            hp = jnp.pad(hp, ((0, pad), (0, 0), (0, 0)))
+            return jax.lax.psum_scatter(hp, axis, scatter_dimension=0,
+                                        tiled=True)
+
+        def search_local(hist, sg, sh, c, can, prm):
+            r = find_best_split(
+                hist, sg, sh, c,
+                local(fmask_p), local(nbpf_p), local(iscat_p),
+                prm.min_data_in_leaf, prm.min_sum_hessian_in_leaf,
+                prm.lambda_l1, prm.lambda_l2, prm.min_gain_to_split, can,
+            )
+            return r._replace(
+                feature=jnp.where(r.feature >= 0, r.feature + start, -1)
+            )
+
+        def search_fn(hist, sg, sh, c, can, _fm, _nb, _ic, prm):
+            # root search: one shard-best SplitInfo per device, one
+            # (packed) all_gather + deterministic max
+            return gather_and_combine(
+                search_local(hist, sg, sh, c, can, prm), axis
+            )
+
+        def search2_fn(hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
+                       _fm, _nb, _ic, prm):
+            # both children's shard-bests ride ONE packed all_gather
+            rl = search_local(hl, lsg, lsh, lc, can, prm)
+            rr = search_local(hr, rsg, rsh, rc, can, prm)
+            both = jnp.stack([pack_split(rl), pack_split(rr)])  # [2, 11]
+            g = jax.lax.all_gather(both, axis)  # [D, 2, 11]
+            w = combine_gathered_split_infos(unpack_split(g))
+            return (SplitResult(*[f[0] for f in w]),
+                    SplitResult(*[f[1] for f in w]))
+
+        def child_counts_fn(nl, nr):
+            # ONE collective for the per-split scalar plumbing: gather the
+            # two local counts, then global sums (smaller-child choice)
+            # and cross-shard maxes (tier gates) are local reductions
+            g = jax.lax.all_gather(jnp.stack([nl, nr]), axis)  # [D, 2]
+            s = jnp.sum(g, axis=0)
+            m = jnp.max(g, axis=0)
+            return s[0], s[1], m[0], m[1]
+
         return grow_tree(
             bins_T,
             grad,
@@ -99,9 +171,12 @@ def data_parallel_sharded(
             params,
             num_bins=num_bins,
             max_leaves=max_leaves,
-            hist_fn=hist_psum,
+            hist_fn=hist_scatter,
             reduce_fn=reduce_sum,
-            reduce_max_fn=reduce_max,
+            search_fn=search_fn,
+            search2_fn=search2_fn,
+            child_counts_fn=child_counts_fn,
+            hist_pool=hist_pool,
         )
 
     return jax.shard_map(
@@ -116,6 +191,7 @@ def data_parallel_sharded(
 def make_data_parallel_grower(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
     growth: str = "leafwise", sorted_hist: bool = False,
+    hist_pool: int = 0,
 ):
     """Build a grow(bins_T, grad, hess, bag_mask, feature_mask,
     num_bins_per_feature, is_categorical, params) -> (tree, leaf_id)
@@ -127,6 +203,6 @@ def make_data_parallel_grower(
     reference's per-level reduce-scatter)."""
     sharded = data_parallel_sharded(
         mesh, num_bins, max_leaves, axis=axis, growth=growth,
-        sorted_hist=sorted_hist,
+        sorted_hist=sorted_hist, hist_pool=hist_pool,
     )
     return row_padded_grower(sharded, mesh.shape[axis])

@@ -6,13 +6,14 @@ ALL rows, but builds histograms and searches thresholds only for its
 feature shard (the greedy bin-balanced assignment of
 feature_parallel_tree_learner.cpp:29-42 becomes a plain contiguous shard
 — bins are uniform-width tensors here, so there is nothing to balance).
-The global best split is an `all_gather` of one SplitInfo per device +
-the reference's deterministic max (larger gain, ties to the smaller
-feature index — SplitInfo::MaxReducer / operator>, split_info.hpp:
-78-104), replacing Network::Allreduce over byte buffers
-(feature_parallel_tree_learner.cpp:64-77).  Every device then performs
-the identical split locally — no split broadcast is needed because data
-is replicated, exactly as in the reference.
+The global best split is ONE packed `all_gather` of each device's best
+SplitInfo + the reference's deterministic max (larger gain, ties to the
+smaller feature index — SplitInfo::MaxReducer / operator>,
+split_info.hpp:78-104), replacing Network::Allreduce over byte buffers
+(feature_parallel_tree_learner.cpp:64-77) — see parallel/split_comm.py.
+Every device then performs the identical split locally — no split
+broadcast is needed because data is replicated, exactly as in the
+reference.
 """
 
 from __future__ import annotations
@@ -24,27 +25,12 @@ from jax.sharding import PartitionSpec as P
 from ..learners.serial import grow_tree
 from ..ops.histogram import histogram_feature_major
 from ..ops.split import SplitResult, find_best_split
-
-# Plain Python int (weakly typed in jnp ops): a module-level jnp constant
-# would initialize the default JAX backend at import time, which hangs
-# when a TPU plugin (axon) claims the platform before the caller pins it.
-_INT_MAX = 2**31 - 1
-
-
-def combine_split_infos(r: SplitResult, axis: str) -> SplitResult:
-    """Allgather each device's best SplitInfo and reduce with the
-    reference's ordering: max gain, ties broken toward the smaller
-    feature index (split_info.hpp:98-103)."""
-    g = jax.lax.all_gather(r, axis)  # SplitResult of [D] arrays
-    feats = jnp.where(g.feature < 0, _INT_MAX, g.feature)
-    max_gain = jnp.max(g.gain)
-    tied = g.gain == max_gain
-    winner = jnp.argmin(jnp.where(tied, feats, _INT_MAX))
-    return SplitResult(*[f[winner] for f in g])
+from .split_comm import gather_and_combine
 
 
 def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int,
-                                 sorted_hist: bool = False):
+                                 sorted_hist: bool = False,
+                                 hist_pool: int = 0):
     axis = mesh.axis_names[0]
     num_shards = mesh.shape[axis]
     from ..ops.histogram import select_single_hist_fn
@@ -82,12 +68,12 @@ def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int,
             r = r._replace(
                 feature=jnp.where(r.feature >= 0, r.feature + start, -1)
             )
-            return combine_split_infos(r, axis)
+            return gather_and_combine(r, axis)
 
         return grow_tree(
             bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
             num_bins=num_bins, max_leaves=max_leaves,
-            hist_fn=hist_fn, search_fn=search_fn,
+            hist_fn=hist_fn, search_fn=search_fn, hist_pool=hist_pool,
         )
 
     sharded = jax.shard_map(

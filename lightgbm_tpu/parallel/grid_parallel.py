@@ -29,7 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..learners.serial import grow_tree
 from ..ops.split import find_best_split
-from .feature_parallel import combine_split_infos
+from .split_comm import gather_and_combine
 from .mesh import FEATURE_AXIS, ROW_AXIS, row_padded_grower
 
 
@@ -44,7 +44,8 @@ def grid_mesh(shape, devices=None) -> Mesh:
 
 
 def make_grid_parallel_grower(mesh: Mesh, num_bins: int, max_leaves: int,
-                              sorted_hist: bool = False):
+                              sorted_hist: bool = False,
+                              hist_pool: int = 0):
     """grow(bins_T, grad, hess, bag_mask, feature_mask, nbpf, is_cat,
     params) -> (tree, leaf_id) over a 2-D (row, feature) mesh."""
     from ..ops.histogram import select_single_hist_fn
@@ -81,7 +82,7 @@ def make_grid_parallel_grower(mesh: Mesh, num_bins: int, max_leaves: int,
             r = r._replace(
                 feature=jnp.where(r.feature >= 0, r.feature + fstart, -1)
             )
-            return combine_split_infos(r, FEATURE_AXIS)
+            return gather_and_combine(r, FEATURE_AXIS)
 
         return grow_tree(
             bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
@@ -90,6 +91,7 @@ def make_grid_parallel_grower(mesh: Mesh, num_bins: int, max_leaves: int,
             search_fn=search_fn,
             reduce_fn=lambda x: jax.lax.psum(x, ROW_AXIS),
             reduce_max_fn=lambda x: jax.lax.pmax(x, ROW_AXIS),
+            hist_pool=hist_pool,
         )
 
     sharded = jax.shard_map(

@@ -146,6 +146,7 @@ def initialize_from_config(cfg=None) -> bool:
 def make_multihost_data_parallel_grower(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
     growth: str = "leafwise", sorted_hist: bool = False,
+    hist_pool: int = 0,
 ):
     """Data-parallel grower across processes: each process feeds its
     LOCAL row partition (the per-rank ingest split, io/distributed.py);
@@ -163,7 +164,7 @@ def make_multihost_data_parallel_grower(
     sharded = jax.jit(
         data_parallel_sharded(
             mesh, num_bins, max_leaves, axis=axis, growth=growth,
-            sorted_hist=sorted_hist,
+            sorted_hist=sorted_hist, hist_pool=hist_pool,
         )
     )
     col_s = NamedSharding(mesh, P(None, axis))

@@ -32,7 +32,7 @@ from .mesh import ROW_AXIS, row_padded_grower
 
 def make_voting_parallel_grower(
     mesh, num_bins: int, max_leaves: int, top_k: int, axis: str = ROW_AXIS,
-    sorted_hist: bool = False,
+    sorted_hist: bool = False, hist_pool: int = 0,
 ):
     num_shards = mesh.shape[axis]
     from ..ops.histogram import select_single_hist_fn
@@ -90,6 +90,7 @@ def make_voting_parallel_grower(
             reduce_fn=lambda x: jax.lax.psum(x, axis),
             search_fn=search_fn,
             reduce_max_fn=lambda x: jax.lax.pmax(x, axis),
+            hist_pool=hist_pool,
         )
 
     sharded = jax.shard_map(

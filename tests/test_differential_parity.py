@@ -223,3 +223,73 @@ def test_differential_edge_cases(ref_exe, tmp_path, tag, mutate, extra):
     ours = lgb.train(params, lgb.Dataset(data), num_boost_round=5)
     np.testing.assert_allclose(ours.predict(X, raw_score=True), ref_pred,
                                atol=1e-5)
+
+
+def test_differential_categorical_metric_parity(ref_exe, tmp_path):
+    """Direct categorical splits (one-vs-rest ==, bin.cpp:155-186):
+    same csv + categorical_column both sides.
+
+    Pointwise parity is impossible here BY THE REFERENCE'S OWN
+    INCONSISTENCY: its categorical split search scores one-vs-rest
+    (feature_histogram.hpp:187-240, left = bin == t) and prediction
+    routes by equality (tree.h:116-122), but its training-time partition
+    routes bin <= t (dense_bin.hpp:106-118 has no categorical branch) —
+    so reference trees are grown on differently-routed rows than they
+    predict.  We keep train == predict routing (the fix later LightGBM
+    versions adopted); this test pins single-split agreement and
+    metric-level parity at 30 rounds, where consistent routing WINS
+    (measured ours 0.9631 vs ref 0.9522; at 8 rounds the reference's
+    accidental group-splits transiently lead by ~0.002)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(31)
+    n = 2500
+    c1 = rng.randint(0, 12, n)
+    c2 = rng.randint(0, 30, n)
+    x3 = rng.randn(n)
+    y = (
+        rng.randn(12)[c1] + 0.7 * rng.randn(30)[c2] + 0.4 * x3
+        + 0.3 * rng.randn(n) > 0
+    ).astype(np.float64)
+    data = os.path.join(str(tmp_path), "diff_cat.csv")
+    np.savetxt(data, np.column_stack([y, c1, c2, x3]), fmt="%.8g",
+               delimiter=",")
+    X = np.loadtxt(data, delimiter=",")[:, 1:]
+    model = os.path.join(str(tmp_path), "cat_ref.txt")
+    conf = [
+        f"data={data}", "task=train", "objective=binary", "num_trees=30",
+        "num_leaves=15", "min_data_in_leaf=20", "categorical_column=0,1",
+        f"output_model={model}", "is_save_binary_file=false", "verbosity=-1",
+    ]
+    r = subprocess.run([ref_exe] + conf, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout[-300:] + r.stderr[-300:]
+    ref_pred = lgb.Booster(model_file=model).predict(X, raw_score=True)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "verbose": -1}
+    ours = lgb.train(params, lgb.Dataset(data, params={
+        "categorical_column": "0,1"}), num_boost_round=30)
+    from sklearn.metrics import roc_auc_score
+
+    auc_ours = roc_auc_score(y, ours.predict(X, raw_score=True))
+    auc_ref = roc_auc_score(y, ref_pred)
+    assert auc_ours >= auc_ref - 1e-3, (auc_ours, auc_ref)
+
+    # a ONE-round stump does agree pointwise (bin mapping, one-vs-rest
+    # gain, category back-mapping): the reference's routing inconsistency
+    # only contaminates scores from the second split / second round on
+    model2 = os.path.join(str(tmp_path), "cat_ref1.txt")
+    conf2 = [c.replace("num_leaves=15", "num_leaves=2")
+             .replace("num_trees=30", "num_trees=1")
+             .replace(model, model2) for c in conf]
+    r2 = subprocess.run([ref_exe] + conf2, capture_output=True, text=True,
+                        timeout=300)
+    assert r2.returncode == 0
+    ours1 = lgb.train(dict(params, num_leaves=2),
+                      lgb.Dataset(data, params={"categorical_column": "0,1"}),
+                      num_boost_round=1)
+    np.testing.assert_allclose(
+        ours1.predict(X, raw_score=True),
+        lgb.Booster(model_file=model2).predict(X, raw_score=True),
+        atol=1e-5,
+    )

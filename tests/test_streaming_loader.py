@@ -84,8 +84,12 @@ def test_streaming_flag_routes_from_file(tmp_path):
 def test_streaming_weight_and_group_columns(tmp_path):
     p = str(tmp_path / "d.csv")
     _write_csv(p, n=900, f=8, weight_col=True, group_col=True)
+    # numeric side-column specs are FEATURE-space (label removed), the
+    # reference's parser semantics (parser.hpp:28-33): csv layout is
+    # label(raw 0), 8 features, weight(raw 9 = feature 8), group(raw 10
+    # = feature 9)
     cfg = Config(
-        max_bin=32, weight_column="9", group_column="10",
+        max_bin=32, weight_column="8", group_column="9",
         is_save_binary_file=False,
     )
     ds_mem = BinnedDataset.from_file(p, cfg)

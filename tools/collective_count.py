@@ -1,0 +1,88 @@
+"""Count cross-device collectives in the compiled data-parallel tree.
+
+Compiles the leaf-wise data-parallel grower over an 8-device virtual CPU
+mesh and counts collective ops in the optimized HLO — the evidence for
+the per-split collective budget documented in parallel/data_parallel.py.
+
+The ops sit inside the fori_loop body (executed num_leaves-1 times per
+tree), so the per-split budget is the count within the while body.
+
+Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+            python tools/collective_count.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# the axon TPU plugin dials its tunnel even under JAX_PLATFORMS=cpu;
+# only the config pin prevents the (possibly hanging) dial
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.learners.serial import TreeLearnerParams  # noqa: E402
+from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower  # noqa: E402
+
+COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)\b"
+)
+
+
+def main() -> None:
+    n, F, B, L = 4096, 12, 32, 15  # small L: the while BODY is what we count
+    rng = np.random.RandomState(0)
+    args = (
+        jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8)),
+        jnp.asarray(rng.randn(n).astype(np.float32)),
+        jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) + 0.1),
+        jnp.ones(n, jnp.float32),
+        jnp.ones(F, bool),
+        jnp.full(F, B, jnp.int32),
+        jnp.zeros(F, bool),
+        TreeLearnerParams.from_config(Config(min_data_in_leaf=20)),
+    )
+    mesh = data_mesh()
+    grow = make_data_parallel_grower(mesh, num_bins=B, max_leaves=L)
+    hlo = jax.jit(grow).lower(*args).compile().as_text()
+
+    # per-computation counts: the while body (the per-split cost, executed
+    # num_leaves-1 times) is the non-ENTRY computation holding collectives
+    blocks: dict[str, list[str]] = {}
+    cur = None
+    for line in hlo.splitlines():
+        if line and not line.startswith(" ") and "{" in line:
+            cur = line.split("{")[0].strip().split(" ")[0]
+            blocks[cur] = []
+        elif cur is not None:
+            blocks[cur].append(line)
+    for name, lines in blocks.items():
+        counts: dict[str, int] = {}
+        for ln in lines:
+            m = COLLECTIVE_RE.search(ln)
+            if m and "-done" not in ln.split("=", 1)[-1][:40] and "=" in ln:
+                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+        if counts:
+            tag = "ENTRY (per-tree setup)" if name.startswith("ENTRY") \
+                else f"{name} (per-split while body)"
+            print(f"{tag}: {counts}")
+
+
+if __name__ == "__main__":
+    main()
