@@ -312,7 +312,14 @@ def main() -> None:
             out["vs_baseline"] = round(ref / ours, 3)
         if ref_auc is not None:
             out["ref_auc"] = round(float(ref_auc), 4)
-            out["auc_gap"] = round(abs(float(ref_auc) - out["train_auc"]), 4)
+            # the north-star clause is "at identical AUC", i.e. NOT WORSE:
+            # auc_gap is the deficit only (0 when we beat the reference);
+            # auc_delta keeps the signed difference for the record
+            delta = out["train_auc"] - float(ref_auc)
+            out["auc_delta"] = round(delta, 4)
+            # NaN must propagate (a missing AUC is a failure, not a pass)
+            gap = float("nan") if delta != delta else max(0.0, -delta)
+            out["auc_gap"] = round(gap, 4)
         if os.environ.get("BENCH_SECONDARY", "0") != "0":
             # optional secondary row: the level-synchronous approximation
             sec, sec_auc, _ = ours_sec_per_tree(X, y, "depthwise")

@@ -44,7 +44,10 @@ class _LevelState(NamedTuple):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "max_leaves", "hist_fn", "reduce_fn")
+    jax.jit,
+    static_argnames=(
+        "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_leaves_fn",
+    ),
 )
 def grow_tree_depthwise(
     bins_T: jax.Array,  # [F, n]
@@ -59,12 +62,17 @@ def grow_tree_depthwise(
     max_leaves: int,
     hist_fn=None,
     reduce_fn=None,
+    search_leaves_fn=None,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree level-by-level; returns (tree, final leaf_id).
 
     ``hist_fn(bins_T, leaf_id, grad, hess, mask, num_leaves) -> [L, F, B, 3]``
-    abstracts the fused histogram so the data-parallel learner can psum the
-    level histogram across the mesh; ``reduce_fn`` is unused here (root
+    abstracts the fused histogram so the data-parallel learner can reduce
+    the level histogram across the mesh (its feature extent may be a
+    shard); ``search_leaves_fn(hist, sum_g, sum_h, cnt, can_split, fmask,
+    nbpf, is_cat, params) -> SplitResult[L]`` abstracts the per-leaf split
+    search so a sharded-search learner can search its feature shard and
+    combine winners in one collective.  ``reduce_fn`` is unused here (root
     stats come from the reduced histogram) but accepted for signature
     parity with the leaf-wise grower.
     """
@@ -75,6 +83,14 @@ def grow_tree_depthwise(
         def hist_fn(bt, lid, g, h, m, num_leaves):
             return histogram_by_leaf(
                 bt, lid, g, h, m, num_bins=num_bins, num_leaves=num_leaves
+            )
+
+    if search_leaves_fn is None:
+        def search_leaves_fn(hist, sg, sh, c, can, fm, nb, ic, prm):
+            return find_best_split_leaves(
+                hist, sg, sh, c, fm, nb, ic,
+                prm.min_data_in_leaf, prm.min_sum_hessian_in_leaf,
+                prm.lambda_l1, prm.lambda_l2, prm.min_gain_to_split, can,
             )
 
     max_levels = jnp.where(
@@ -94,12 +110,10 @@ def grow_tree_depthwise(
         depth_ok = (params.max_depth <= 0) | (t.leaf_depth < params.max_depth)
         can_split = live & depth_ok
 
-        best: SplitResult = find_best_split_leaves(
+        best: SplitResult = search_leaves_fn(
             hist, sum_g, sum_h, cnt,
-            feature_mask, num_bins_per_feature, is_categorical,
-            params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
-            params.lambda_l1, params.lambda_l2, params.min_gain_to_split,
             can_split,
+            feature_mask, num_bins_per_feature, is_categorical, params,
         )
 
         # ---- budget selection: top-gain splits, at most L - num_leaves

@@ -84,23 +84,10 @@ def data_parallel_sharded(
                 num_bins=num_bins, num_leaves=num_leaves,
             )
 
-    def level_hist_psum(bins_T, leaf_id, grad, hess, mask, num_leaves):
-        return jax.lax.psum(
-            local_level_hist(bins_T, leaf_id, grad, hess, mask, num_leaves),
-            axis,
-        )
-
     def reduce_sum(x):
         return jax.lax.psum(x, axis)
 
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
-        if growth == "depthwise":
-            return grow_tree_depthwise(
-                bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
-                num_bins=num_bins, max_leaves=max_leaves,
-                hist_fn=level_hist_psum,
-            )
-
         F = bins_T.shape[0]
         Fs = -(-F // num_shards)  # feature-shard width of the scattered hist
         pad = Fs * num_shards - F
@@ -111,6 +98,46 @@ def data_parallel_sharded(
 
         def local(a):
             return jax.lax.dynamic_slice_in_dim(a, start, Fs, axis=0)
+
+        def offset_feature(r):
+            return r._replace(
+                feature=jnp.where(r.feature >= 0, r.feature + start, -1)
+            )
+
+        if growth == "depthwise":
+            from ..ops.split import find_best_split_leaves
+
+            def level_hist_scatter(bt, lid, g, h, m, num_leaves):
+                # one reduce-scatter per LEVEL of [L, F, B, 3] feature
+                # blocks — the reference's per-level ReduceScatter
+                # (data_parallel_tree_learner.cpp:127-157) at half an
+                # allreduce's bytes; each device keeps [L, F/D, B, 3]
+                hl = local_level_hist(bt, lid, g, h, m, num_leaves)
+                hl = jnp.pad(hl, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                return jax.lax.psum_scatter(hl, axis, scatter_dimension=1,
+                                            tiled=True)
+
+            def search_leaves_fn(hist, sg, sh, c, can, _fm, _nb, _ic, prm):
+                # per-leaf shard search + ONE packed [D, L, 11] combine
+                # (the SplitInfo allreduce,
+                # data_parallel_tree_learner.cpp:192-227)
+                r = find_best_split_leaves(
+                    hist, sg, sh, c,
+                    local(fmask_p), local(nbpf_p), local(iscat_p),
+                    prm.min_data_in_leaf, prm.min_sum_hessian_in_leaf,
+                    prm.lambda_l1, prm.lambda_l2, prm.min_gain_to_split,
+                    can,
+                )
+                r = offset_feature(r)
+                g2 = jax.lax.all_gather(pack_split(r), axis)  # [D, L, 11]
+                return combine_gathered_split_infos(unpack_split(g2))
+
+            return grow_tree_depthwise(
+                bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
+                num_bins=num_bins, max_leaves=max_leaves,
+                hist_fn=level_hist_scatter,
+                search_leaves_fn=search_leaves_fn,
+            )
 
         def hist_scatter(bins_arg, g, h, m):
             # local full-feature partials -> reduce-scatter feature blocks:
@@ -129,9 +156,7 @@ def data_parallel_sharded(
                 prm.min_data_in_leaf, prm.min_sum_hessian_in_leaf,
                 prm.lambda_l1, prm.lambda_l2, prm.min_gain_to_split, can,
             )
-            return r._replace(
-                feature=jnp.where(r.feature >= 0, r.feature + start, -1)
-            )
+            return offset_feature(r)
 
         def search_fn(hist, sg, sh, c, can, _fm, _nb, _ic, prm):
             # root search: one shard-best SplitInfo per device, one
@@ -198,9 +223,10 @@ def make_data_parallel_grower(
     callable running the serial growth algorithm SPMD over ``mesh``.
 
     ``growth="depthwise"`` runs the level-synchronous learner instead:
-    the per-level fused histogram is psum'd once per LEVEL (one collective
-    per level instead of one per split — even less comm than the
-    reference's per-level reduce-scatter)."""
+    per LEVEL, one psum_scatter of [L, F, B, 3] feature blocks + one
+    packed SplitInfo all_gather (two collectives per level at half an
+    allreduce's histogram bytes — the reference's per-level
+    reduce-scatter + SplitInfo allreduce pattern)."""
     sharded = data_parallel_sharded(
         mesh, num_bins, max_leaves, axis=axis, growth=growth,
         sorted_hist=sorted_hist, hist_pool=hist_pool,
