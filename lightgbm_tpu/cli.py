@@ -144,9 +144,13 @@ def run_train(cfg: Config) -> GBDT:
         # Network::Init analog (application.cpp:190): attach this process
         # to the multi-host JAX runtime before any data loads, so the
         # per-rank ingest partition and mapper allgather see the world
-        from .parallel.multihost import initialize_from_config
+        from .parallel.multihost import (initialize_from_config,
+                                         sync_config_across_processes)
 
         initialize_from_config(cfg)
+        # GlobalSyncUpByMin analog (application.cpp:110-127, 190-198):
+        # reconcile seeds/fractions, verify structural params match
+        sync_config_across_processes(cfg)
     t0 = time.perf_counter()
     train = BinnedDataset.from_file(cfg.data, cfg)
     Log.info(

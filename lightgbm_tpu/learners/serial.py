@@ -121,7 +121,11 @@ def _hist_tiers(n: int):
     LGBM_TPU_TIER_SPACING (read at TRACE time; default 2) sets the
     geometric step between capacities: 2 wastes <2x gather work per
     split but instantiates ~9 tier bodies (one Mosaic kernel compile
-    each on TPU); 4 halves the compile cost for <4x gather waste."""
+    each on TPU); 4 halves the tier count for <4x gather waste.
+    Measured XLA:CPU compile at n=1M, L=255, B=255 (segment hist):
+    spacing=2 (9 tiers) 9.5s, spacing=4 (5 tiers) 13.8s — tier count is
+    NOT the compile bottleneck off-TPU; the knob exists for the Mosaic
+    per-kernel compile path."""
     import os
 
     step = max(2, int(os.environ.get("LGBM_TPU_TIER_SPACING", "2")))

@@ -57,12 +57,28 @@ def _local_addresses() -> set:
     return addrs
 
 
+def _already_distributed() -> bool:
+    """Whether jax.distributed.initialize already ran in this process.
+
+    Checked WITHOUT jax.process_count(): that call initializes the XLA
+    backend as a side effect, after which jax.distributed.initialize
+    refuses to run ("must be called before any JAX calls") — probing via
+    process_count would permanently break the machine_list_file bootstrap
+    it is guarding."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_from_config(cfg=None) -> bool:
     """Attach to (or bootstrap) the multi-process JAX runtime when the
     config/env asks for more than one machine.  Returns True when this
     process is part of a >1-process world.  Idempotent."""
-    if jax.process_count() > 1:
-        return True
+    if _already_distributed():
+        return jax.process_count() > 1
 
     coord = os.environ.get("LGBM_TPU_COORDINATOR", "")
     nproc = int(os.environ.get("LGBM_TPU_NUM_PROCESSES", "0") or 0)
@@ -141,6 +157,68 @@ def initialize_from_config(cfg=None) -> bool:
                 _time.sleep(min(10.0, max(0.0, deadline - _time.monotonic())))
         return jax.process_count() > 1
     return False
+
+
+def sync_config_across_processes(cfg) -> None:
+    """Cross-process config agreement — the reference's GlobalSyncUpByMin
+    (application.cpp:110-127, 190-198, 259-270): randomized-behavior
+    seeds/fractions take the MIN across ranks so every machine samples
+    identically, and the load-bearing training params are fingerprinted
+    and verified equal (the reference trusts operators to ship the same
+    conf file; we fail fast instead of silently training a mixed world).
+    No-op single-process.  Mutates ``cfg`` in place."""
+    if jax.process_count() <= 1 or cfg is None:
+        return
+    from jax.experimental import multihost_utils
+
+    # Exchange VALUES losslessly: under the default x64-disabled mode,
+    # process_allgather downcasts f64->f32 / i64->i32 on the way through
+    # the device, which would corrupt seeds >= 2^24 and add f32 drift to
+    # fractions even when every rank already agrees.  Seeds ride as
+    # int32 (config ints); fractions ride as their f64 BIT PATTERN in
+    # two int32 lanes and are reassembled host-side before the min.
+    seed_names = ("data_random_seed", "feature_fraction_seed", "bagging_seed")
+    frac_names = ("feature_fraction", "bagging_fraction")
+    seeds = np.asarray(
+        [int(getattr(cfg, k, 0)) for k in seed_names], np.int32
+    )
+    fracs = np.asarray(
+        [float(getattr(cfg, k, 1.0)) for k in frac_names], np.float64
+    )
+    payload = np.concatenate([seeds, fracs.view(np.int32)])  # [3 + 4] i32
+    gathered = multihost_utils.process_allgather(payload)  # [P, 7] i32
+    gathered = np.ascontiguousarray(np.asarray(gathered))
+    seed_min = gathered[:, :3].min(axis=0)
+    frac_all = gathered[:, 3:].view(np.float64)  # [P, 2]
+    frac_min = frac_all.min(axis=0)
+    for k, v in zip(seed_names, seed_min):
+        if hasattr(cfg, k):
+            setattr(cfg, k, int(v))
+    for k, v in zip(frac_names, frac_min):
+        if hasattr(cfg, k):
+            setattr(cfg, k, float(v))
+
+    # structural params must MATCH, not reconcile: a rank training with a
+    # different tree shape would diverge at the first collective
+    import zlib
+
+    fp_src = "|".join(
+        f"{k}={getattr(cfg, k, None)}" for k in (
+            "objective", "num_iterations", "learning_rate", "num_leaves_",
+            "max_bin", "min_data_in_leaf", "min_sum_hessian_in_leaf",
+            "lambda_l1", "lambda_l2", "max_depth", "tree_learner",
+            "tree_growth", "boosting_type", "num_class",
+        )
+    )
+    # crc32 is uint32; mask to int31 so the int32 transport is lossless
+    fp = np.asarray([zlib.crc32(fp_src.encode()) & 0x7FFFFFFF], np.int32)
+    fps = np.asarray(multihost_utils.process_allgather(fp)).ravel()
+    if len(set(int(x) for x in fps)) > 1:
+        Log.fatal(
+            "training config differs across processes "
+            f"(fingerprints {sorted(set(int(x) for x in fps))}); every "
+            "rank must run with identical structural parameters"
+        )
 
 
 def make_multihost_data_parallel_grower(

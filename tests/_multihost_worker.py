@@ -41,6 +41,22 @@ def main() -> None:
 
     assert initialize_from_config(None)  # idempotent once attached
 
+    # GlobalSyncUpByMin analog: divergent seeds reconcile to the
+    # cross-process MIN; identical structural params pass the
+    # fingerprint check (application.cpp:110-127, 190-198)
+    from lightgbm_tpu.parallel.multihost import sync_config_across_processes
+
+    # the big seed and the fraction must round-trip LOSSLESSLY (an f32
+    # transport would turn 20000003 into 20000004 and 0.8 into
+    # 0.800000011920929)
+    sync_cfg = Config(bagging_seed=10 + pid, feature_fraction_seed=7 - pid,
+                      data_random_seed=20000003, feature_fraction=0.8)
+    sync_config_across_processes(sync_cfg)
+    assert sync_cfg.bagging_seed == 10, sync_cfg.bagging_seed
+    assert sync_cfg.feature_fraction_seed == 6, sync_cfg.feature_fraction_seed
+    assert sync_cfg.data_random_seed == 20000003, sync_cfg.data_random_seed
+    assert sync_cfg.feature_fraction == 0.8, sync_cfg.feature_fraction
+
     # deterministic shared problem; each process keeps a contiguous half
     n, F, B, L = 2048, 10, 32, 31
     rng = np.random.RandomState(5)

@@ -83,3 +83,31 @@ def test_large_feature_count_trains_in_budget():
     # growth far past the 10 resident slots proves eviction + recompute
     assert int(tree.num_leaves) > 50
     assert np.isfinite(np.asarray(booster._scores)).all()
+
+
+def test_pooled_data_parallel_matches_unpooled():
+    """The LRU pool composes with the reduce-scatter data-parallel
+    learner: per-device slots hold [Fs, B, 3] shards and the recompute
+    branch runs the same psum_scatter as a child histogram."""
+    import jax
+
+    from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower
+
+    assert len(jax.devices()) == 8
+    n, F, B, L = 3000, 10, 32, 31
+    args = _problem(n, F, B, seed=12)
+    params = _params()
+    mesh = data_mesh()
+    g0 = make_data_parallel_grower(mesh, num_bins=B, max_leaves=L)
+    g1 = make_data_parallel_grower(mesh, num_bins=B, max_leaves=L,
+                                   hist_pool=4)
+    t0, leaf0 = g0(*args, params)
+    t1, leaf1 = g1(*args, params)
+    assert int(t0.num_leaves) == int(t1.num_leaves)
+    nl = int(t0.num_leaves)
+    for f in ("split_feature", "threshold_bin", "leaf_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t0, f))[:nl], np.asarray(getattr(t1, f))[:nl],
+            err_msg=f,
+        )
+    np.testing.assert_array_equal(np.asarray(leaf0), np.asarray(leaf1))
