@@ -323,7 +323,7 @@ class Config:
             self.boosting_type = "gbdt"
         if self.boosting_type not in ("gbdt", "dart"):
             raise ValueError(f"Unknown boosting_type: {self.boosting_type!r}")
-        if self.tree_growth not in ("leafwise", "depthwise"):
+        if self.tree_growth not in ("leafwise", "depthwise", "hybrid"):
             raise ValueError(f"Unknown tree_growth: {self.tree_growth!r}")
         if self.hist_impl not in ("auto", "segment", "matmul"):
             raise ValueError(f"Unknown hist_impl: {self.hist_impl!r}")

@@ -47,6 +47,7 @@ class _LevelState(NamedTuple):
     jax.jit,
     static_argnames=(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_leaves_fn",
+        "stop_before_budget",
     ),
 )
 def grow_tree_depthwise(
@@ -63,6 +64,7 @@ def grow_tree_depthwise(
     hist_fn=None,
     reduce_fn=None,
     search_leaves_fn=None,
+    stop_before_budget: int = 0,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree level-by-level; returns (tree, final leaf_id).
 
@@ -219,6 +221,18 @@ def grow_tree_depthwise(
             & (state.num_leaves + n_sel < L)
             & (state.depth + 1 < max_levels)
         )
+        if stop_before_budget:
+            # hybrid phase 1 (learners/hybrid.py): hand over to the
+            # best-first phase while num_leaves is still <= L/factor, so
+            # no level is ever truncated by the top-gain budget selection
+            # AND the refinement phase keeps enough budget to spend
+            # best-first (factor=4 measured leafwise-parity AUC; factor=2
+            # — the largest no-truncation-possible cap — still trailed by
+            # ~0.002 because forcing a full weak frontier level spends
+            # budget best-first would have used deeper)
+            keep_going = keep_going & (
+                stop_before_budget * (state.num_leaves + n_sel) <= L
+            )
         return _LevelState(
             leaf_id=leaf_id,
             tree=tree,

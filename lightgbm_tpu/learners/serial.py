@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.tree import Tree, empty_tree
-from ..ops.histogram import histogram_feature_major
+from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from ..ops.split import SplitResult, find_best_split, K_MIN_SCORE
 
 
@@ -234,6 +234,7 @@ def default_search_fn(
     static_argnames=(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
         "reduce_max_fn", "child_counts_fn", "search2_fn", "hist_pool",
+        "init_hist_fn",
     ),
 )
 def grow_tree(
@@ -254,6 +255,9 @@ def grow_tree(
     child_counts_fn=None,
     search2_fn=None,
     hist_pool: int = 0,
+    init_tree=None,
+    init_leaf_id=None,
+    init_hist_fn=None,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -279,6 +283,13 @@ def grow_tree(
       searches BOTH children in one go so a sharded-search learner can
       combine the two results in a single all_gather.  Default: two
       ``search_fn`` calls.
+
+    ``init_tree``/``init_leaf_id`` resume best-first growth from an
+    existing partial tree (the hybrid growth mode, learners/hybrid.py):
+    the persistent partition is rebuilt from the row->leaf map, per-leaf
+    histograms come from one fused pass, and the loop continues numbering
+    nodes from ``init_tree.num_leaves - 1``.  Single-device only (no
+    search/reduce hooks) and exclusive with ``hist_pool``.
 
     ``hist_pool`` bounds histogram HBM: when ``2 <= hist_pool <
     max_leaves`` only that many leaf histograms stay resident
@@ -329,51 +340,113 @@ def grow_tree(
                       params),
         )
 
-    # ---- root (BeforeTrain / LeafSplits::Init, leaf_splits.hpp:51-92)
-    hist0 = hist_fn(bins_T, grad, hess, bag_mask)
-    sum_g0 = jnp.sum(grad * bag_mask)
-    sum_h0 = jnp.sum(hess * bag_mask)
-    cnt0 = jnp.sum(bag_mask)
-    if reduce_fn is not None:
-        # one stacked collective for the tree-start allreduce
-        s = reduce_fn(jnp.stack([sum_g0, sum_h0, cnt0]))
-        sum_g0, sum_h0, cnt0 = s[0], s[1], s[2]
-
-    # hist0's feature extent may be a shard of F (feature-parallel
-    # learner); accumulation dtype follows grad/hess — float64 when
-    # Config.hist_dtype asks for the reference's double accumulation
-    # (include/LightGBM/bin.h:21-22)
-    acc_dt = hist0.dtype
+    if init_tree is None:
+        # ---- root (BeforeTrain / LeafSplits::Init, leaf_splits.hpp:51-92)
+        hist0 = hist_fn(bins_T, grad, hess, bag_mask)
+        sum_g0 = jnp.sum(grad * bag_mask)
+        sum_h0 = jnp.sum(hess * bag_mask)
+        cnt0 = jnp.sum(bag_mask)
+        if reduce_fn is not None:
+            # one stacked collective for the tree-start allreduce
+            s = reduce_fn(jnp.stack([sum_g0, sum_h0, cnt0]))
+            sum_g0, sum_h0, cnt0 = s[0], s[1], s[2]
+        # hist0's feature extent may be a shard of F (feature-parallel
+        # learner); accumulation dtype follows grad/hess — float64 when
+        # Config.hist_dtype asks for the reference's double accumulation
+        # (include/LightGBM/bin.h:21-22)
+        acc_dt = hist0.dtype
+    else:
+        acc_dt = jnp.promote_types(grad.dtype, jnp.float32)
     pooled = 0 < hist_pool < L
     P = max(hist_pool, 2) if pooled else L
-    state = _GrowState(
-        order=jnp.concatenate(
-            [
-                jnp.arange(n, dtype=jnp.int32),
-                jnp.full(order_pad, n, jnp.int32),
-            ]
-        ),
-        leaf_begin=jnp.zeros(L, jnp.int32),
-        pos_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
-        # root gate: every shard's padded local row count is the same n
-        gate_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
-        hists=jnp.zeros((P,) + hist0.shape, acc_dt).at[0].set(hist0),
-        slot_of=(jnp.full(L, -1, jnp.int32).at[0].set(0) if pooled
-                 else jnp.zeros(0, jnp.int32)),
-        slot_leaf=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
-                   else jnp.zeros(0, jnp.int32)),
-        slot_last=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
-                   else jnp.zeros(0, jnp.int32)),
-        sum_g=jnp.zeros(L, acc_dt).at[0].set(sum_g0),
-        sum_h=jnp.zeros(L, acc_dt).at[0].set(sum_h0),
-        cnt=jnp.zeros(L, acc_dt).at[0].set(cnt0),
-        best=_set_best(
-            _empty_best(L, acc_dt),
-            0,
-            best_for(hist0, sum_g0, sum_h0, cnt0, jnp.int32(0)),
-        ),
-        tree=empty_tree(L),
-    )
+    if init_tree is not None:
+        assert not pooled and search_fn is default_search_fn and \
+            reduce_fn is None, "init_tree resume is single-device, unpooled"
+        from ..ops.split import find_best_split_leaves
+
+        K0 = init_tree.num_leaves.astype(jnp.int32)
+        lid = init_leaf_id.astype(jnp.int32)
+        # leaf-sorted permutation + contiguous per-leaf ranges from the
+        # row->leaf map (stable: preserves row order within a leaf)
+        order0 = jnp.argsort(lid, stable=True).astype(jnp.int32)
+        counts = jnp.zeros(L, jnp.int32).at[lid].add(1)
+        begin0 = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+        )
+        # every live leaf's histogram in ONE fused pass, through the same
+        # level-histogram kernel the depthwise phase used (the Pallas MXU
+        # sorted kernel on TPU; init_hist_fn has the depthwise hist_fn
+        # signature)
+        if init_hist_fn is None:
+            fused = histogram_by_leaf(
+                bins_T, lid, grad, hess, bag_mask,
+                num_bins=num_bins, num_leaves=L,
+            ).astype(acc_dt)
+        else:
+            fused = init_hist_fn(
+                bins_T, lid, grad, hess, bag_mask, L
+            ).astype(acc_dt)
+        leaf_tot = jnp.sum(fused[:, 0, :, :], axis=1)  # [L, 3]
+        live = jnp.arange(L, dtype=jnp.int32) < K0
+        can0 = live & (
+            (params.max_depth <= 0)
+            | (init_tree.leaf_depth < params.max_depth)
+        )
+        best0 = find_best_split_leaves(
+            fused, leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2],
+            feature_mask, num_bins_per_feature, is_categorical,
+            params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
+            params.lambda_l1, params.lambda_l2, params.min_gain_to_split,
+            can0,
+        )
+        state = _GrowState(
+            order=jnp.concatenate(
+                [order0, jnp.full(order_pad, n, jnp.int32)]
+            ),
+            leaf_begin=begin0,
+            pos_cnt=counts,
+            gate_cnt=counts,
+            hists=fused,
+            slot_of=jnp.zeros(0, jnp.int32),
+            slot_leaf=jnp.zeros(0, jnp.int32),
+            slot_last=jnp.zeros(0, jnp.int32),
+            sum_g=leaf_tot[:, 0],
+            sum_h=leaf_tot[:, 1],
+            cnt=leaf_tot[:, 2],
+            best=best0,
+            tree=init_tree,
+        )
+        start_step = K0 - 1
+    else:
+        state = _GrowState(
+            order=jnp.concatenate(
+                [
+                    jnp.arange(n, dtype=jnp.int32),
+                    jnp.full(order_pad, n, jnp.int32),
+                ]
+            ),
+            leaf_begin=jnp.zeros(L, jnp.int32),
+            pos_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
+            # root gate: every shard's padded local row count is the same n
+            gate_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
+            hists=jnp.zeros((P,) + hist0.shape, acc_dt).at[0].set(hist0),
+            slot_of=(jnp.full(L, -1, jnp.int32).at[0].set(0) if pooled
+                     else jnp.zeros(0, jnp.int32)),
+            slot_leaf=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
+                       else jnp.zeros(0, jnp.int32)),
+            slot_last=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
+                       else jnp.zeros(0, jnp.int32)),
+            sum_g=jnp.zeros(L, acc_dt).at[0].set(sum_g0),
+            sum_h=jnp.zeros(L, acc_dt).at[0].set(sum_h0),
+            cnt=jnp.zeros(L, acc_dt).at[0].set(cnt0),
+            best=_set_best(
+                _empty_best(L, acc_dt),
+                0,
+                best_for(hist0, sum_g0, sum_h0, cnt0, jnp.int32(0)),
+            ),
+            tree=empty_tree(L),
+        )
+        start_step = 0
 
     def split_branch(state, step, best_leaf, do_split):
         """One split step with MASKED writes: when ``do_split`` is false
@@ -633,7 +706,7 @@ def grow_tree(
         do_split = state.best.gain[best_leaf] > 0.0
         return split_branch(state, jnp.int32(step), best_leaf, do_split)
 
-    state = jax.lax.fori_loop(0, L - 1, body, state)
+    state = jax.lax.fori_loop(start_step, L - 1, body, state)
 
     # ---- per-row leaf assignment from the final ranges: leaves own
     # disjoint contiguous [begin, begin+count) spans of ``order``, so the

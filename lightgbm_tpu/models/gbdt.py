@@ -127,6 +127,16 @@ class GBDT:
         SPMD over the local device mesh — the reference's `num_machines`
         world (network.cpp:20-38) is the mesh's row axis."""
         tl = self.config.tree_learner
+        if (self.config.tree_growth == "hybrid"
+                and (jax.process_count() > 1
+                     or (tl != "serial" and len(jax.devices()) > 1))):
+            from ..log import Log
+
+            Log.warning(
+                "tree_growth=hybrid is single-device only; parallel "
+                "learners run leaf-wise growth (same accuracy, no fused "
+                "level phase)"
+            )
         if jax.process_count() > 1:
             # true multi-host world (Network::Init analog already ran,
             # parallel/multihost.py): rows are the per-process ingest
@@ -160,6 +170,16 @@ class GBDT:
                     num_bins=self._num_bins,
                     max_leaves=self.max_leaves,
                     hist_fn=self._depthwise_hist_fn(),
+                )
+            if self.config.tree_growth == "hybrid":
+                from ..learners.hybrid import grow_tree_hybrid
+
+                return functools.partial(
+                    grow_tree_hybrid,
+                    num_bins=self._num_bins,
+                    max_leaves=self.max_leaves,
+                    hist_fn=self._leafwise_hist_fn(),
+                    level_hist_fn=self._depthwise_hist_fn(),
                 )
             return functools.partial(
                 grow_tree,
@@ -230,12 +250,13 @@ class GBDT:
         mb = float(self.config.histogram_pool_size)
         if mb <= 0:
             return 0
-        if self.config.tree_growth == "depthwise":
+        if self.config.tree_growth in ("depthwise", "hybrid"):
             from ..log import Log
 
             Log.warning(
-                "histogram_pool_size is ignored for tree_growth=depthwise "
-                "(per-level histograms are transient, not leaf-resident)"
+                f"histogram_pool_size is ignored for tree_growth="
+                f"{self.config.tree_growth} (depthwise levels build "
+                "transient histograms; the hybrid resume runs unpooled)"
             )
             return 0
         itemsize = 8 if self._use_f64_hist else 4

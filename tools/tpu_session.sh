@@ -1,0 +1,57 @@
+#!/bin/bash
+# One-shot TPU measurement session: run the moment the tunnel is alive.
+# Produces every number VERDICT r2 asked for, in priority order, so a
+# short tunnel window still yields the headline result first.
+#
+#   bash tools/tpu_session.sh [outdir]
+#
+# Prior state: the axon tunnel dies unpredictably (jax.devices() HANGS);
+# every stage below runs in its own subprocess with a timeout so a
+# mid-session death loses one stage, not the session.
+
+set -u
+OUT=${1:-/tmp/tpu_session_$(date +%H%M)}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((8, 8)); (x @ x).block_until_ready()
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+print('tpu alive')" >/dev/null 2>&1
+}
+
+stage() {  # stage <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  if ! probe; then echo "[$name] SKIP: tunnel dead"; return 1; fi
+  echo "[$name] running ..."
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  echo "[$name] rc=$rc; tail:"
+  tail -3 "$OUT/$name.out"
+  return $rc
+}
+
+# 1. headline: leafwise 1M bench (VERDICT r2 item 1) — kernel v1
+stage bench_1m_v1 2400 env BENCH_TREES=20 python bench.py
+
+# 2. kernel A/B: v1 vs bsub (run once per variant; env read at trace)
+stage kernel_ab_v1 2400 env LGBM_TPU_HIST_KERNEL=v1 python tools/kernel_ab.py
+stage kernel_ab_bsub 2400 env LGBM_TPU_HIST_KERNEL=bsub python tools/kernel_ab.py
+
+# 3. bench with bsub if the A/B says it wins (recorded either way)
+stage bench_1m_bsub 2400 env LGBM_TPU_HIST_KERNEL=bsub BENCH_TREES=20 python bench.py
+
+# 4. HIGGS-10M shape (VERDICT r2 item 3)
+stage bench_10m 5400 env BENCH_ROWS=10000000 BENCH_TREES=20 BENCH_BUDGET_S=1800 python bench.py
+
+# 5. categorical + lambdarank rows (VERDICT r2 items 7-8)
+stage catbench 3600 env CATBENCH_ROWS=300000 python tools/bench_categorical.py
+stage rankbench 3600 env RANKBENCH_QUERIES=1000 python tools/bench_lambdarank.py
+
+# 6. depthwise secondary row
+stage bench_1m_depthwise 2400 env BENCH_GROWTH=depthwise BENCH_TREES=20 python bench.py
+
+echo "session artifacts in $OUT"
+grep -h '"metric"\|"rows"\|"queries"' "$OUT"/*.out 2>/dev/null
