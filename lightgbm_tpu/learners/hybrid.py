@@ -31,6 +31,13 @@ import jax
 from .depthwise import grow_tree_depthwise
 from .serial import grow_tree
 
+# Phase-1 handoff: grow levels while the frontier stays within
+# max_leaves // HYBRID_STOP_FACTOR (4 measured leafwise-parity AUC;
+# 2 trails by ~0.002 — module docstring).  The sharded hybrid in
+# parallel/data_parallel.py MUST use the same factor or serial and
+# data-parallel hybrid trees diverge structurally.
+HYBRID_STOP_FACTOR = 4
+
 
 @functools.partial(
     jax.jit,
@@ -55,7 +62,7 @@ def grow_tree_hybrid(
         bins_T, grad, hess, bag_mask, feature_mask, num_bins_per_feature,
         is_categorical, params,
         num_bins=num_bins, max_leaves=max_leaves,
-        hist_fn=level_hist_fn, stop_before_budget=4,
+        hist_fn=level_hist_fn, stop_before_budget=HYBRID_STOP_FACTOR,
     )
     return grow_tree(
         bins_T, grad, hess, bag_mask, feature_mask, num_bins_per_feature,

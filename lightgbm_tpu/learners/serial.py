@@ -234,7 +234,7 @@ def default_search_fn(
     static_argnames=(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
         "reduce_max_fn", "child_counts_fn", "search2_fn", "hist_pool",
-        "init_hist_fn",
+        "init_hist_fn", "init_search_fn",
     ),
 )
 def grow_tree(
@@ -258,6 +258,7 @@ def grow_tree(
     init_tree=None,
     init_leaf_id=None,
     init_hist_fn=None,
+    init_search_fn=None,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -287,9 +288,12 @@ def grow_tree(
     ``init_tree``/``init_leaf_id`` resume best-first growth from an
     existing partial tree (the hybrid growth mode, learners/hybrid.py):
     the persistent partition is rebuilt from the row->leaf map, per-leaf
-    histograms come from one fused pass, and the loop continues numbering
-    nodes from ``init_tree.num_leaves - 1``.  Single-device only (no
-    search/reduce hooks) and exclusive with ``hist_pool``.
+    histograms come from one fused pass (``init_hist_fn``, the depthwise
+    level kernel), and the loop continues numbering nodes from
+    ``init_tree.num_leaves - 1``.  Sharded learners resume too:
+    ``init_search_fn`` searches the fused histogram's feature shard and
+    combines, ``reduce_max_fn`` lifts the rebuilt positional counts to
+    cross-shard tier gates.  Exclusive with ``hist_pool``.
 
     ``hist_pool`` bounds histogram HBM: when ``2 <= hist_pool <
     max_leaves`` only that many leaf histograms stay resident
@@ -360,19 +364,21 @@ def grow_tree(
     pooled = 0 < hist_pool < L
     P = max(hist_pool, 2) if pooled else L
     if init_tree is not None:
-        assert not pooled and search_fn is default_search_fn and \
-            reduce_fn is None, "init_tree resume is single-device, unpooled"
+        assert not pooled, "init_tree resume is unpooled"
         from ..ops.split import find_best_split_leaves
 
         K0 = init_tree.num_leaves.astype(jnp.int32)
         lid = init_leaf_id.astype(jnp.int32)
         # leaf-sorted permutation + contiguous per-leaf ranges from the
-        # row->leaf map (stable: preserves row order within a leaf)
+        # row->leaf map (stable: preserves row order within a leaf);
+        # under row sharding these are LOCAL ranges, while the fused
+        # histogram/search below see GLOBAL stats through the hooks
         order0 = jnp.argsort(lid, stable=True).astype(jnp.int32)
         counts = jnp.zeros(L, jnp.int32).at[lid].add(1)
         begin0 = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
         )
+        gate0 = counts if reduce_max_fn is None else reduce_max_fn(counts)
         # every live leaf's histogram in ONE fused pass, through the same
         # level-histogram kernel the depthwise phase used (the Pallas MXU
         # sorted kernel on TPU; init_hist_fn has the depthwise hist_fn
@@ -392,20 +398,29 @@ def grow_tree(
             (params.max_depth <= 0)
             | (init_tree.leaf_depth < params.max_depth)
         )
-        best0 = find_best_split_leaves(
-            fused, leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2],
-            feature_mask, num_bins_per_feature, is_categorical,
-            params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
-            params.lambda_l1, params.lambda_l2, params.min_gain_to_split,
-            can0,
-        )
+        if init_search_fn is not None:
+            # sharded-search learners search their feature shard of the
+            # fused histogram and combine winners in one collective
+            best0 = init_search_fn(
+                fused, leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2],
+                can0, feature_mask, num_bins_per_feature, is_categorical,
+                params,
+            )
+        else:
+            best0 = find_best_split_leaves(
+                fused, leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2],
+                feature_mask, num_bins_per_feature, is_categorical,
+                params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
+                params.lambda_l1, params.lambda_l2, params.min_gain_to_split,
+                can0,
+            )
         state = _GrowState(
             order=jnp.concatenate(
                 [order0, jnp.full(order_pad, n, jnp.int32)]
             ),
             leaf_begin=begin0,
             pos_cnt=counts,
-            gate_cnt=counts,
+            gate_cnt=gate0,
             hists=fused,
             slot_of=jnp.zeros(0, jnp.int32),
             slot_leaf=jnp.zeros(0, jnp.int32),

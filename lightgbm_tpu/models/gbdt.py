@@ -128,14 +128,14 @@ class GBDT:
         world (network.cpp:20-38) is the mesh's row axis."""
         tl = self.config.tree_learner
         if (self.config.tree_growth == "hybrid"
-                and (jax.process_count() > 1
-                     or (tl != "serial" and len(jax.devices()) > 1))):
+                and tl in ("feature", "voting", "grid")
+                and len(jax.devices()) > 1 and jax.process_count() == 1):
             from ..log import Log
 
             Log.warning(
-                "tree_growth=hybrid is single-device only; parallel "
-                "learners run leaf-wise growth (same accuracy, no fused "
-                "level phase)"
+                "tree_growth=hybrid runs on serial and data-parallel "
+                f"learners; tree_learner={tl} uses leaf-wise growth "
+                "(same accuracy, no fused level phase)"
             )
         if jax.process_count() > 1:
             # true multi-host world (Network::Init analog already ran,

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..learners.depthwise import grow_tree_depthwise
+from ..learners.hybrid import HYBRID_STOP_FACTOR
 from ..learners.serial import grow_tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from ..ops.split import SplitResult, find_best_split
@@ -104,7 +105,7 @@ def data_parallel_sharded(
                 feature=jnp.where(r.feature >= 0, r.feature + start, -1)
             )
 
-        if growth == "depthwise":
+        if growth in ("depthwise", "hybrid"):
             from ..ops.split import find_best_split_leaves
 
             def level_hist_scatter(bt, lid, g, h, m, num_leaves):
@@ -132,13 +133,14 @@ def data_parallel_sharded(
                 g2 = jax.lax.all_gather(pack_split(r), axis)  # [D, L, 11]
                 return combine_gathered_split_infos(unpack_split(g2))
 
-            return grow_tree_depthwise(
-                bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
-                num_bins=num_bins, max_leaves=max_leaves,
-                hist_fn=level_hist_scatter,
-                search_leaves_fn=search_leaves_fn,
-            )
-
+            if growth == "depthwise":
+                return grow_tree_depthwise(
+                    bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat,
+                    params,
+                    num_bins=num_bins, max_leaves=max_leaves,
+                    hist_fn=level_hist_scatter,
+                    search_leaves_fn=search_leaves_fn,
+                )
         def hist_scatter(bins_arg, g, h, m):
             # local full-feature partials -> reduce-scatter feature blocks:
             # this device leaves owning the GLOBAL histogram of features
@@ -184,6 +186,32 @@ def data_parallel_sharded(
             s = jnp.sum(g, axis=0)
             m = jnp.max(g, axis=0)
             return s[0], s[1], m[0], m[1]
+
+        if growth == "hybrid":
+            # sharded hybrid: depthwise phase with the per-level
+            # reduce-scatter, then the best-first phase resumes with the
+            # same sharded hooks (learners/hybrid.py semantics)
+            tree1, leaf1 = grow_tree_depthwise(
+                bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
+                num_bins=num_bins, max_leaves=max_leaves,
+                hist_fn=level_hist_scatter,
+                search_leaves_fn=search_leaves_fn,
+                stop_before_budget=HYBRID_STOP_FACTOR,
+            )
+            return grow_tree(
+                bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
+                num_bins=num_bins, max_leaves=max_leaves,
+                hist_fn=hist_scatter,
+                reduce_fn=reduce_sum,
+                search_fn=search_fn,
+                search2_fn=search2_fn,
+                child_counts_fn=child_counts_fn,
+                init_tree=tree1,
+                init_leaf_id=leaf1,
+                init_hist_fn=level_hist_scatter,
+                init_search_fn=search_leaves_fn,
+                reduce_max_fn=lambda c: jax.lax.pmax(c, axis),
+            )
 
         return grow_tree(
             bins_T,

@@ -63,3 +63,43 @@ def test_hybrid_phase1_never_truncates():
     # stop rule gates the NEXT level at L/4; one more full frontier can
     # double it, so the handoff bound is ~L/2
     assert int(t1.num_leaves) * 2 <= L + 1, int(t1.num_leaves)
+
+
+def test_hybrid_data_parallel_matches_serial_hybrid():
+    """Sharded hybrid (depthwise reduce-scatter phase + best-first resume
+    with sharded hooks) must reproduce single-device hybrid trees up to
+    float reduction order (the DP invariant, split_info.hpp:98-103)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learners.hybrid import grow_tree_hybrid
+    from lightgbm_tpu.learners.serial import TreeLearnerParams
+    from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower
+
+    assert len(jax.devices()) == 8
+    rng = np.random.RandomState(9)
+    n, F, B, L = 4000, 12, 32, 31
+    args = (
+        jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8)),
+        jnp.asarray(rng.randn(n).astype(np.float32)),
+        jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) + 0.1),
+        jnp.ones(n, jnp.float32), jnp.ones(F, bool),
+        jnp.full(F, B, jnp.int32), jnp.zeros(F, bool),
+    )
+    params = TreeLearnerParams.from_config(
+        Config(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+    )
+    t0, leaf0 = grow_tree_hybrid(*args, params, num_bins=B, max_leaves=L)
+    grow = make_data_parallel_grower(
+        data_mesh(), num_bins=B, max_leaves=L, growth="hybrid"
+    )
+    t1, leaf1 = grow(*args, params)
+    assert int(t0.num_leaves) == int(t1.num_leaves)
+    nl = int(t0.num_leaves)
+    diverged = sum(
+        1 for i in range(nl - 1)
+        if any(int(np.asarray(getattr(t0, f))[i])
+               != int(np.asarray(getattr(t1, f))[i])
+               for f in ("split_feature", "threshold_bin"))
+    )
+    assert diverged <= 1, f"{diverged} of {nl - 1} splits diverged"
