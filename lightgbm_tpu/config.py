@@ -331,6 +331,37 @@ class Config:
             raise ValueError(f"Unknown hist_dtype: {self.hist_dtype!r}")
         if self.max_bin < 2:
             raise ValueError("max_bin must be >= 2")
+        # value-range CHECKs from the reference (config.cpp:275-307)
+        if self.num_leaves <= 1:
+            raise ValueError("num_leaves must be > 1")
+        if not 0.0 < self.feature_fraction <= 1.0:
+            raise ValueError("feature_fraction must be in (0, 1]")
+        if not 0.0 < self.bagging_fraction <= 1.0:
+            raise ValueError("bagging_fraction must be in (0, 1]")
+        if self.bagging_freq < 0:
+            raise ValueError("bagging_freq must be >= 0")
+        if self.learning_rate <= 0.0:
+            raise ValueError("learning_rate must be > 0")
+        if self.lambda_l1 < 0.0 or self.lambda_l2 < 0.0:
+            raise ValueError("lambda_l1/lambda_l2 must be >= 0")
+        if self.min_gain_to_split < 0.0:
+            raise ValueError("min_gain_to_split must be >= 0")
+        if not (self.max_depth > 1 or self.max_depth < 0):
+            raise ValueError("max_depth must be > 1 (or < 0 for unlimited)")
+        if self.num_iterations < 0:
+            raise ValueError("num_iterations must be >= 0")
+        if self.early_stopping_round < 0:
+            raise ValueError("early_stopping_round must be >= 0")
+        if not (self.min_sum_hessian_in_leaf > 1.0 or self.min_data_in_leaf > 0):
+            raise ValueError(
+                "need min_sum_hessian_in_leaf > 1.0 or min_data_in_leaf > 0"
+            )
+        if self.metric_freq < 0:
+            raise ValueError("metric_freq must be >= 0")
+        if not 0.0 <= self.drop_rate <= 1.0:
+            raise ValueError("drop_rate must be in [0, 1]")
+        if not 0.0 <= self.skip_drop <= 1.0:
+            raise ValueError("skip_drop must be in [0, 1]")
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -76,3 +76,27 @@ def test_unknown_param_warns(capsys):
     err = capsys.readouterr().err
     assert "Unknown parameter: num_leavs" in err
     assert c.num_leaves == 127  # default untouched
+
+
+@pytest.mark.parametrize("bad", [
+    {"num_leaves": 1},
+    {"feature_fraction": 0.0},
+    {"feature_fraction": 1.5},
+    {"bagging_fraction": 2.5},
+    {"learning_rate": 0.0},
+    {"lambda_l1": -1.0},
+    {"max_depth": 1},
+    {"num_iterations": -3},
+    {"min_data_in_leaf": 0, "min_sum_hessian_in_leaf": 0.5},
+    {"metric_freq": -1},
+    {"drop_rate": 2.0},
+    {"skip_drop": -0.1},
+])
+def test_value_range_checks(bad):
+    """Reference CHECK()s (config.cpp:270-317) are enforced."""
+    with pytest.raises(ValueError):
+        Config.from_dict(bad)
+
+
+def test_value_range_valid_edges():
+    Config.from_dict({"max_depth": -1, "num_leaves": 2})
