@@ -1,8 +1,9 @@
-"""Worker for the 2-process multi-host test (launched by
-tests/test_multihost.py).  Each process holds HALF the rows; the
-multihost data-parallel grower must reproduce the single-process serial
-tree exactly (the reference's parallel==serial invariant across
-machines, split_info.hpp:98-103)."""
+"""Worker for the multi-process multi-host tests (launched by
+tests/test_multihost.py; process count from LGBM_TPU_NUM_PROCESSES,
+default 2).  Each process holds 1/NP of the rows; the multihost
+data-parallel grower must reproduce the single-process serial tree
+exactly (the reference's parallel==serial invariant across machines,
+split_info.hpp:98-103)."""
 
 import os
 import sys
@@ -24,11 +25,14 @@ import numpy as np  # noqa: E402
 def main() -> None:
     coord = os.environ["LGBM_TPU_COORDINATOR"]
     pid = int(os.environ["LGBM_TPU_PROCESS_ID"])
+    NP = int(os.environ.get("LGBM_TPU_NUM_PROCESSES", "2"))
     jax.distributed.initialize(
-        coordinator_address=coord, num_processes=2, process_id=pid
+        coordinator_address=coord, num_processes=NP, process_id=pid
     )
-    assert jax.process_count() == 2
-    assert len(jax.devices()) == 8, f"expected 8 global devices, got {len(jax.devices())}"
+    assert jax.process_count() == NP
+    expect_dev = int(os.environ.get("LGBM_TPU_EXPECT_DEVICES", "8"))
+    assert len(jax.devices()) == expect_dev, (
+        f"expected {expect_dev} global devices, got {len(jax.devices())}")
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from lightgbm_tpu.config import Config
@@ -49,21 +53,22 @@ def main() -> None:
     # the big seed and the fraction must round-trip LOSSLESSLY (an f32
     # transport would turn 20000003 into 20000004 and 0.8 into
     # 0.800000011920929)
-    sync_cfg = Config(bagging_seed=10 + pid, feature_fraction_seed=7 - pid,
+    sync_cfg = Config(bagging_seed=10 + pid, feature_fraction_seed=17 - pid,
                       data_random_seed=20000003, feature_fraction=0.8)
     sync_config_across_processes(sync_cfg)
     assert sync_cfg.bagging_seed == 10, sync_cfg.bagging_seed
-    assert sync_cfg.feature_fraction_seed == 6, sync_cfg.feature_fraction_seed
+    assert sync_cfg.feature_fraction_seed == 17 - (NP - 1), \
+        sync_cfg.feature_fraction_seed
     assert sync_cfg.data_random_seed == 20000003, sync_cfg.data_random_seed
     assert sync_cfg.feature_fraction == 0.8, sync_cfg.feature_fraction
 
-    # deterministic shared problem; each process keeps a contiguous half
+    # deterministic shared problem; each process keeps a contiguous slice
     n, F, B, L = 2048, 10, 32, 31
     rng = np.random.RandomState(5)
     bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
     grad = rng.randn(n).astype(np.float32)
     hess = (np.abs(rng.randn(n)) + 0.1).astype(np.float32)
-    half = n // 2
+    half = n // NP
     lo, hi = pid * half, (pid + 1) * half
 
     cfg = Config(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
@@ -128,7 +133,7 @@ def main() -> None:
     yf = (Xf[:, 0] + 0.5 * Xf[:, 1] * Xf[:, 2] > 0).astype(np.float32)
     cfg2 = Config(
         objective="binary", num_leaves=15, min_data_in_leaf=20,
-        tree_learner="data", num_machines=2, metric=["binary_logloss"],
+        tree_learner="data", num_machines=NP, metric=["binary_logloss"],
     )
     mappers = find_bin_mappers(Xf, max_bin=cfg2.max_bin)  # full-data: identical
     ds = BinnedDataset.from_matrix(

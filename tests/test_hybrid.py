@@ -103,3 +103,29 @@ def test_hybrid_data_parallel_matches_serial_hybrid():
                for f in ("split_feature", "threshold_bin"))
     )
     assert diverged <= 1, f"{diverged} of {nl - 1} splits diverged"
+
+
+def test_hybrid_with_bagging_and_feature_fraction():
+    """The resume path must respect bag_mask (fused init histogram masks
+    dropped rows; positional counts still cover them) and a feature
+    subset — end-to-end through GBDT."""
+    X, y = bench.make_data(20_000, seed=4)
+    cfg = Config(
+        objective="binary", num_leaves=31, max_bin=63, min_data_in_leaf=20,
+        metric=["auc"], tree_growth="hybrid", tree_learner="serial",
+        bagging_fraction=0.7, bagging_freq=1, feature_fraction=0.8,
+    )
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata, len(y)))
+    for _ in range(8):
+        booster.train_one_iter()
+    auc = booster.eval_at(0)["auc"]
+    assert 0.7 < auc <= 1.0, auc
+    t = booster.models[-1]
+    assert np.isfinite(np.asarray(t.leaf_value)).all()
+    nl = int(t.num_leaves)
+    assert nl > 8
+    # leaf counts reflect BAGGED rows (SplitInfo stats): they must sum to
+    # ~bagging_fraction * n, not n
+    total = int(np.asarray(t.leaf_count)[:nl].sum())
+    assert abs(total - 0.7 * len(y)) < 0.02 * len(y), total
