@@ -36,3 +36,18 @@ def reference_examples():
     if not os.path.isdir(path):
         pytest.skip("reference examples not available")
     return path
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Bound in-process compiled-executable accumulation.
+
+    A full-suite run compiles hundreds of XLA:CPU programs in one
+    process; on this VM (compile/host CPU-feature mismatch — XLA warns
+    'could lead to execution errors such as SIGILL') the accumulation
+    has produced rare late-suite segfaults inside backend_compile.
+    Dropping compiled caches between modules keeps the process small;
+    within-module caching (the expensive tier-chain compiles reused
+    across a module's tests) is unaffected."""
+    yield
+    jax.clear_caches()
