@@ -74,3 +74,21 @@ def pin_cpu_if_default_dead(timeout_s: float = 240.0, log=None) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+
+def require_tpu_or_row(platform: str, **row) -> bool:
+    """Fail-fast contract for the measurement harnesses under
+    tools/tpu_watch.sh: when ``BENCH_REQUIRE_TPU`` is set and the
+    resolved backend is not the TPU, print the one-line JSON row the
+    watcher's free-retry check recognizes (``platform`` + ``error``,
+    plus any caller fields) and return False so the caller exits without
+    burning hours on a CPU-fallback measurement.  Returns True when the
+    run may proceed."""
+    import json
+
+    if platform == "tpu" or os.environ.get("BENCH_REQUIRE_TPU", "0") == "0":
+        return True
+    print(json.dumps({**row, "platform": platform,
+                      "error": "BENCH_REQUIRE_TPU: backend is not tpu"}),
+          flush=True)
+    return False

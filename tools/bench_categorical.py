@@ -121,6 +121,13 @@ def main():
         from lightgbm_tpu.backend import pin_cpu_if_default_dead
 
         pin_cpu_if_default_dead(timeout_s=60, log=log)
+    import jax
+
+    from lightgbm_tpu.backend import require_tpu_or_row
+
+    platform = jax.devices()[0].platform  # stamped BEFORE timing anything
+    if not require_tpu_or_row(platform, rows=ROWS):
+        return
 
     Xn, Xc, y = make_data(ROWS)
     X_direct = np.column_stack([Xn, Xc])
@@ -167,6 +174,7 @@ def main():
         if d and o:
             results[f"{k}_direct_speedup_vs_onehot"] = round(
                 o["sec_per_tree"] / d["sec_per_tree"], 2)
+    results["platform"] = platform
     print(json.dumps({"rows": ROWS, "trees": TREES, **results}))
 
 

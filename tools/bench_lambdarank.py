@@ -82,6 +82,11 @@ def main():
     else:
         from lightgbm_tpu.backend import pin_cpu_if_default_dead
         pin_cpu_if_default_dead(timeout_s=60, log=log)
+    import jax
+    from lightgbm_tpu.backend import require_tpu_or_row
+    platform = jax.devices()[0].platform  # stamped BEFORE timing anything
+    if not require_tpu_or_row(platform, queries=NQ):
+        return
 
     X, y, sizes = make_data(NQ)
     n = len(y)
@@ -142,6 +147,7 @@ def main():
                 log(f"ref: {ref_s:.3f}s/tree NDCG@10={ref_ndcg:.4f}")
             else:
                 log(f"ref failed: {p.stdout[-300:]} {p.stderr[-300:]}")
+    results["platform"] = platform
     print(json.dumps(results))
 
 

@@ -35,9 +35,18 @@ def t(fn, reps=5):
 
 
 def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if not plat and os.environ.get("BENCH_REQUIRE_TPU", "0") != "0":
+        # probe BEFORE backend init: a dead tunnel makes jax.devices()
+        # hang for the watcher's whole stage timeout otherwise
+        from lightgbm_tpu.backend import default_backend_alive, require_tpu_or_row
+
+        if not default_backend_alive(90):
+            require_tpu_or_row("none")
+            return
+
     import jax
 
-    plat = os.environ.get("BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
@@ -46,6 +55,14 @@ def main():
         histogram_by_leaf_sorted, histogram_single_leaf)
 
     print("devices:", jax.devices(), flush=True)
+    if os.environ.get("BENCH_REQUIRE_TPU", "0") != "0":
+        # the pre-init probe above only proves SOME backend answers; if
+        # the axon plugin failed fast and jax fell back to CPU, the
+        # interpret-mode sweep would burn the whole stage window
+        from lightgbm_tpu.backend import require_tpu_or_row
+
+        if not require_tpu_or_row(jax.default_backend()):
+            return
     interpret = jax.default_backend() != "tpu"
     rng = np.random.RandomState(0)
     F, B, L = 28, 255, 255
@@ -101,7 +118,13 @@ def main():
             print(f"gather cap={cap} FAILED: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
-    # end-to-end growth modes (uses LGBM_TPU_HIST_KERNEL env default)
+    # end-to-end growth modes (uses LGBM_TPU_HIST_KERNEL env default).
+    # KERNEL_AB_SKIP_E2E=1 stops here: the end-to-end leafwise compile is
+    # the giant one (~9 tier bodies; >40 min observed on the tunnel), and
+    # the watcher covers end-to-end via the bench stages — the micro
+    # numbers above are this tool's unique output.
+    if os.environ.get("KERNEL_AB_SKIP_E2E", "0") != "0":
+        return
     import bench
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset

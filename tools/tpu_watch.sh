@@ -1,0 +1,180 @@
+#!/bin/bash
+# Persistent TPU-window watcher: probe the axon tunnel every POLL_S
+# seconds and, whenever it is alive, run the highest-priority PENDING
+# measurement stage.  Stage success is tracked by marker files in the
+# output dir, so short tunnel windows accumulate progress instead of
+# restarting the whole plan (round-3 evidence: windows are short and
+# unpredictable; a 40-min leafwise compile was killed mid-window).
+#
+#   bash tools/tpu_watch.sh [outdir]     # runs until all stages settle
+#
+# Design:
+# * smallest compiles first: kernel A/B micro timings (KERNEL_AB_SKIP_E2E=1)
+#   validate the Pallas path on-chip in minutes and pick the histogram
+#   kernel variant the bench stages then use.
+# * the giant leafwise end-to-end compile gets long windows and a
+#   reduced-tier variant first (LGBM_TPU_TIER_SPACING=4 halves the
+#   Mosaic kernel count vs 2) so at least one end-to-end executable
+#   lands in .bench/jaxcache — after which every later bench run
+#   (including the driver's) is cache-warm.
+# * BENCH_REQUIRE_TPU=1 makes the harnesses fail fast instead of
+#   silently burning a multi-hour CPU-fallback run when the tunnel dies
+#   between the probe and backend init; such runs (platform none/cpu in
+#   the result row) do NOT consume one of the stage's bounded attempts.
+# * a successful 1M bench row's OWN "knobs" field (tier spacing + kernel
+#   as actually used) is what pick_tuned records to .bench/tuned.json,
+#   so the driver's bench.py traces exactly the cached program.
+
+set -u
+OUT=${1:-/tmp/tpu_watch}
+POLL_S=${POLL_S:-60}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((8, 8)); (x @ x).block_until_ready()
+assert jax.devices()[0].platform == "tpu", jax.devices()
+EOF
+}
+
+# run <name> <timeout_s> <max_attempts> <cmd...>
+#
+# Success needs BOTH rc=0 AND evidence the measurement really ran on
+# the chip: bench.py's one-JSON-line contract means it exits 0 even
+# when the TPU died mid-run (it prints platform:"none"/value:0), so
+# exit status alone would mark a dead stage done forever.  A run whose
+# row shows a non-TPU platform never reached the chip — it does not
+# consume an attempt.  Stages that exhaust max_attempts on real-TPU
+# failures get a .giveup marker so all_done can terminate.
+run() {
+  local name=$1 tmo=$2 maxtry=$3; shift 3
+  [ -e "$OUT/$name.ok" ] || [ -e "$OUT/$name.giveup" ] && return 0
+  local tries=0
+  [ -e "$OUT/$name.tries" ] && tries=$(cat "$OUT/$name.tries")
+  echo "[$(date -u +%H:%M:%S)] [$name] attempt $((tries + 1)) ..."
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  local good=0
+  if [ $rc -eq 0 ]; then
+    case $name in
+      bench_*|catbench|rankbench)
+        # last line must be a real-TPU row AND not an error row (an
+        # on-TPU failure prints platform "tpu" plus an "error" field —
+        # that must count as a bounded attempt, not success)
+        tail -1 "$OUT/$name.out" | grep -q '"platform": "tpu"' \
+          && ! tail -1 "$OUT/$name.out" | grep -q '"error"' && good=1 ;;
+      *)
+        # kernel_ab: a TPU device line alone is not enough (the
+        # BENCH_REQUIRE_TPU fail-fast row contains the substring "tpu",
+        # and an all-FAILED sweep still prints the device list) — also
+        # require at least one parsed timing line
+        grep -Eq '^devices:.*[Tt][Pp][Uu]' "$OUT/$name.out" \
+          && grep -Eq 'single-leaf .*\]: [0-9.]+ ms' "$OUT/$name.out" \
+          && good=1 ;;
+    esac
+  fi
+  if [ $good -eq 1 ]; then
+    touch "$OUT/$name.ok"
+    echo "[$(date -u +%H:%M:%S)] [$name] OK; tail:"
+    tail -3 "$OUT/$name.out"
+    return 1
+  fi
+  if tail -1 "$OUT/$name.out" 2>/dev/null | \
+      grep -q '"platform": "\(none\|cpu\)"'; then
+    # tunnel died before the chip ran anything: free retry
+    echo "[$(date -u +%H:%M:%S)] [$name] no-TPU fallback (attempt not counted)"
+    return 1
+  fi
+  echo "$((tries + 1))" > "$OUT/$name.tries"
+  echo "[$(date -u +%H:%M:%S)] [$name] rc=$rc (attempt $((tries + 1))/$maxtry); tail:"
+  tail -2 "$OUT/$name.out" "$OUT/$name.err" 2>/dev/null
+  if [ "$((tries + 1))" -ge "$maxtry" ]; then
+    touch "$OUT/$name.giveup"
+    echo "[$(date -u +%H:%M:%S)] [$name] giving up after $maxtry attempts"
+  fi
+  return 1  # ran something this window: re-probe before more
+}
+
+all_done() {
+  for s in kernel_ab bench_1m_s4 bench_1m_s2 bench_10m \
+           catbench rankbench bench_1m_depthwise; do
+    [ -e "$OUT/$s.ok" ] || [ -e "$OUT/$s.giveup" ] || return 1
+  done
+  return 0
+}
+
+# Histogram-kernel variant for the bench stages: kernel_ab.py's micro
+# sweep times BOTH variants in one run (each line tagged [v1]/[bsub]);
+# compare the single-leaf timings (the leafwise hot kernel) per tag.
+# Default v1 (the only chip-proven variant) until the sweep is in.
+kernel_choice() {
+  if [ -e "$OUT/kernel_choice" ]; then cat "$OUT/kernel_choice"; return; fi
+  if [ -e "$OUT/kernel_ab.ok" ]; then
+    python - "$OUT" <<'EOF'
+import re, sys
+out = sys.argv[1]
+totals = {"v1": [], "bsub": []}
+try:
+    for line in open(f"{out}/kernel_ab.out"):
+        m = re.match(r"single-leaf .*\[(v1|bsub)\]: ([0-9.]+) ms", line)
+        if m:
+            totals[m.group(1)].append(float(m.group(2)))
+except OSError:
+    pass
+v1, bs = totals["v1"], totals["bsub"]
+# bsub must beat v1 on a complete sweep (equal line counts) to win
+win = "bsub" if (v1 and len(bs) == len(v1) and sum(bs) < sum(v1)) else "v1"
+open(f"{out}/kernel_choice", "w").write(win)
+print(win)
+EOF
+  else
+    echo v1
+  fi
+}
+
+pick_tuned() {  # record the winning 1M run's own knobs for bench.py
+  python - "$OUT" <<'EOF'
+import json, os, sys
+out = sys.argv[1]
+best = None
+for name in ("bench_1m_s4", "bench_1m_s2"):
+    if not os.path.exists(os.path.join(out, name + ".ok")):
+        continue
+    try:
+        with open(os.path.join(out, name + ".out")) as fh:
+            row = json.loads(fh.read().strip().splitlines()[-1])
+    except Exception:
+        continue
+    if row.get("platform") == "tpu" and row.get("value", 0) > 0:
+        if best is None or row["value"] < best[0]:
+            best = (row["value"], row.get("knobs", {}))
+if best is not None and best[1]:
+    os.makedirs(".bench", exist_ok=True)
+    with open(".bench/tuned.json", "w") as fh:
+        json.dump(best[1], fh)
+    print("tuned.json <-", best[1], "at", best[0], "s/tree")
+EOF
+}
+
+while ! all_done; do
+  if ! probe; then
+    sleep "$POLL_S"
+    continue
+  fi
+  echo "[$(date -u +%H:%M:%S)] tunnel ALIVE"
+  K=$(kernel_choice)
+  # one stage per probe round; priority order, small compiles first
+  run kernel_ab 1500 4 env BENCH_REQUIRE_TPU=1 KERNEL_AB_SKIP_E2E=1 python tools/kernel_ab.py && \
+  run bench_1m_s4 5400 4 env BENCH_REQUIRE_TPU=1 LGBM_TPU_TIER_SPACING=4 LGBM_TPU_HIST_KERNEL="$K" BENCH_TREES=20 python bench.py && \
+  run bench_1m_s2 5400 3 env BENCH_REQUIRE_TPU=1 LGBM_TPU_TIER_SPACING=2 LGBM_TPU_HIST_KERNEL="$K" BENCH_TREES=20 python bench.py && \
+  run bench_10m 7200 3 env BENCH_REQUIRE_TPU=1 LGBM_TPU_TIER_SPACING=4 LGBM_TPU_HIST_KERNEL="$K" BENCH_ROWS=10000000 BENCH_TREES=20 BENCH_BUDGET_S=1800 python bench.py && \
+  run catbench 3600 3 env BENCH_REQUIRE_TPU=1 CATBENCH_ROWS=300000 python tools/bench_categorical.py && \
+  run rankbench 3600 3 env BENCH_REQUIRE_TPU=1 RANKBENCH_QUERIES=1000 python tools/bench_lambdarank.py && \
+  run bench_1m_depthwise 3600 3 env BENCH_REQUIRE_TPU=1 LGBM_TPU_HIST_KERNEL="$K" BENCH_GROWTH=depthwise BENCH_TREES=20 python bench.py
+  pick_tuned
+done
+pick_tuned  # the loop can exit right after the last stage's run
+echo "[$(date -u +%H:%M:%S)] all stages done"
+grep -h '"metric"\|"rows"\|"queries"' "$OUT"/*.out 2>/dev/null
