@@ -45,7 +45,8 @@ import jax.numpy as jnp
 
 from ..models.tree import Tree, empty_tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
-from ..ops.split import SplitResult, find_best_split, K_MIN_SCORE
+from ..ops.split import (
+    SplitResult, find_best_split, find_best_split_leaves, K_MIN_SCORE)
 
 
 class TreeLearnerParams(NamedTuple):
@@ -314,6 +315,29 @@ def grow_tree(
         hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
     if search_fn is None:
         search_fn = default_search_fn
+        if search2_fn is None:
+            # default two-child search BATCHED through the vmapped
+            # kernel: one set of large [2, F, B, 3] ops instead of two
+            # independent op soups — the round-3 TPU profile showed the
+            # per-split search fusions costing 4x the histogram kernel
+            from ..ops.split import find_best_split_leaves
+
+            def search2_fn(hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
+                           fmask, nbpf, is_cat, prm):
+                res = find_best_split_leaves(
+                    jnp.stack([hl, hr]),
+                    jnp.stack([lsg, rsg]),
+                    jnp.stack([lsh, rsh]),
+                    jnp.stack([lc, rc]),
+                    fmask, nbpf, is_cat,
+                    prm.min_data_in_leaf, prm.min_sum_hessian_in_leaf,
+                    prm.lambda_l1, prm.lambda_l2, prm.min_gain_to_split,
+                    jnp.stack([can, can]),
+                )
+                return (
+                    SplitResult(*[a[0] for a in res]),
+                    SplitResult(*[a[1] for a in res]),
+                )
     if child_counts_fn is None:
         _sum = (lambda x: x) if reduce_fn is None else reduce_fn
         _max = (lambda x: x) if reduce_max_fn is None else reduce_max_fn

@@ -38,17 +38,20 @@ ROWS = int(float(sys.argv[1])) if len(sys.argv) > 1 else 1_000_000
 F = 28
 
 
-def t(fn, reps=5):
+def t(fn, reps=20):
+    """Enqueue all reps asynchronously, block once: over the axon tunnel
+    a per-rep block_until_ready pays the full ~25 ms RTT per rep and
+    times the TUNNEL, not the op (first sweep run measured every op at
+    a 25/63 ms RTT quantum)."""
     import jax
 
-    r = fn()
-    jax.block_until_ready(r)
-    best = float("inf")
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
     for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        best = min(best, time.perf_counter() - t0)
-    return best * 1e3
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3
 
 
 def main():
@@ -84,7 +87,8 @@ def main():
         np.ascontiguousarray(
             np.pad(np.asarray(rec).T, ((0, 0), (0, 32 - R)))))  # [n, 32]
 
-    for cap in (ROWS // 2, ROWS // 8, ROWS // 32):
+    for cap in (ROWS // 2 // 512 * 512, ROWS // 8 // 512 * 512,
+                ROWS // 32 // 512 * 512):
         idx = jnp.asarray(rng.randint(0, ROWS, cap).astype(np.int32))
         idx_sorted = jnp.sort(idx)
 
