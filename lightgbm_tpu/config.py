@@ -263,6 +263,10 @@ class Config:
     def __post_init__(self):
         if not self.metric:
             self.metric = []
+        # the reference's CHECKs fire on every construction path
+        # (config.cpp:275-307 runs from Config::Init) — a direct
+        # Config(...) call must not bypass them
+        self._check_conflicts()
 
     # -- derived flags (CheckParamConflict, config.cpp:136-175)
     @property
@@ -305,9 +309,7 @@ class Config:
                 kwargs[k] = [float(x) for x in _to_str_list(v)]
             else:
                 kwargs[k] = str(v)
-        cfg = cls(**kwargs)
-        cfg._check_conflicts()
-        return cfg
+        return cls(**kwargs)  # __post_init__ runs _check_conflicts
 
     def _check_conflicts(self) -> None:
         """Mirror CheckParamConflict (config.cpp:136-175)."""
@@ -346,8 +348,9 @@ class Config:
             raise ValueError("lambda_l1/lambda_l2 must be >= 0")
         if self.min_gain_to_split < 0.0:
             raise ValueError("min_gain_to_split must be >= 0")
-        if not (self.max_depth > 1 or self.max_depth < 0):
-            raise ValueError("max_depth must be > 1 (or < 0 for unlimited)")
+        # no max_depth CHECK: the reference accepts any value and treats
+        # <= 0 as unlimited (config.h:182, serial_tree_learner.cpp:238),
+        # and the learners here gate on max_depth <= 0 the same way
         if self.num_iterations < 0:
             raise ValueError("num_iterations must be >= 0")
         if self.early_stopping_round < 0:

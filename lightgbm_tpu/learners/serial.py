@@ -320,8 +320,6 @@ def grow_tree(
             # kernel: one set of large [2, F, B, 3] ops instead of two
             # independent op soups — the round-3 TPU profile showed the
             # per-split search fusions costing 4x the histogram kernel
-            from ..ops.split import find_best_split_leaves
-
             def search2_fn(hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
                            fmask, nbpf, is_cat, prm):
                 res = find_best_split_leaves(
@@ -389,8 +387,6 @@ def grow_tree(
     P = max(hist_pool, 2) if pooled else L
     if init_tree is not None:
         assert not pooled, "init_tree resume is unpooled"
-        from ..ops.split import find_best_split_leaves
-
         K0 = init_tree.num_leaves.astype(jnp.int32)
         lid = init_leaf_id.astype(jnp.int32)
         # leaf-sorted permutation + contiguous per-leaf ranges from the

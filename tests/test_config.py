@@ -85,7 +85,6 @@ def test_unknown_param_warns(capsys):
     {"bagging_fraction": 2.5},
     {"learning_rate": 0.0},
     {"lambda_l1": -1.0},
-    {"max_depth": 1},
     {"num_iterations": -3},
     {"min_data_in_leaf": 0, "min_sum_hessian_in_leaf": 0.5},
     {"metric_freq": -1},
@@ -100,3 +99,11 @@ def test_value_range_checks(bad):
 
 def test_value_range_valid_edges():
     Config.from_dict({"max_depth": -1, "num_leaves": 2})
+    # the reference has NO max_depth CHECK (config.cpp:270-317);
+    # <= 0 means unlimited (config.h:182) and any positive value is
+    # accepted, so direct construction must accept these too
+    Config(max_depth=0)
+    Config(max_depth=1)
+    # CHECKs fire on the constructor path as well, not only from_dict
+    with pytest.raises(ValueError):
+        Config(num_leaves=1)

@@ -87,8 +87,9 @@ def main():
         np.ascontiguousarray(
             np.pad(np.asarray(rec).T, ((0, 0), (0, 32 - R)))))  # [n, 32]
 
-    for cap in (ROWS // 2 // 512 * 512, ROWS // 8 // 512 * 512,
-                ROWS // 32 // 512 * 512):
+    for cap in (max(512, ROWS // 2 // 512 * 512),
+                max(512, ROWS // 8 // 512 * 512),
+                max(512, ROWS // 32 // 512 * 512)):
         idx = jnp.asarray(rng.randint(0, ROWS, cap).astype(np.int32))
         idx_sorted = jnp.sort(idx)
 
