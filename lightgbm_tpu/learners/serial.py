@@ -235,7 +235,7 @@ def default_search_fn(
     static_argnames=(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
         "reduce_max_fn", "child_counts_fn", "search2_fn", "hist_pool",
-        "init_hist_fn", "init_search_fn",
+        "init_hist_fn", "init_search_fn", "hist_fn_raw",
     ),
 )
 def grow_tree(
@@ -260,6 +260,7 @@ def grow_tree(
     init_leaf_id=None,
     init_hist_fn=None,
     init_search_fn=None,
+    hist_fn_raw=None,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -313,15 +314,63 @@ def grow_tree(
 
     if hist_fn is None:
         hist_fn = functools.partial(histogram_feature_major, num_bins=num_bins)
+    # ---- opt mode: the whole split step stays in the histogram
+    # kernel's NATIVE [Fp, 4, Bp] layout (raw hist kernel -> subtract ->
+    # raw search kernel), eliminating the per-split layout-churn fusions
+    # the round-3 profile showed radiating from the [F, B, 3] transpose
+    # (~0.5 ms/split).  Only the default serial hook set qualifies;
+    # parallel learners and the hybrid resume keep the canonical layout.
+    import os as _os
+
+    _kern_env = _os.environ.get("LGBM_TPU_SEARCH_KERNEL", "pallas") != "jnp"
+    opt = (
+        hist_fn_raw is not None
+        and search_fn is None
+        and search2_fn is None
+        and init_tree is None
+        and grad.dtype == jnp.float32
+        # the raw layout REQUIRES the raw search kernel, so the
+        # LGBM_TPU_SEARCH_KERNEL=jnp escape hatch disables opt wholesale
+        and _kern_env
+    )
     if search_fn is None:
         search_fn = default_search_fn
         if search2_fn is None:
-            # default two-child search BATCHED through the vmapped
-            # kernel: one set of large [2, F, B, 3] ops instead of two
-            # independent op soups — the round-3 TPU profile showed the
-            # per-split search fusions costing 4x the histogram kernel
+            use_kernel = jax.default_backend() == "tpu" and _kern_env
+            _interp = jax.default_backend() != "tpu"
+
             def search2_fn(hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
                            fmask, nbpf, is_cat, prm):
+                # TPU: the whole two-child search is ONE Pallas launch
+                # (ops/pallas_search.py) — the round-3 profile showed
+                # the jnp search compiling to ~60 small fusions per
+                # split (~1.6 ms, 4x the histogram kernel), all per-op
+                # overhead no jnp restructuring removes.  The jnp path
+                # stays the reference implementation (CPU, float64).
+                if opt:
+                    from ..ops.pallas_search import search2_pallas_raw
+
+                    return search2_pallas_raw(
+                        jnp.stack([hl, hr]),
+                        lsg, lsh, lc, rsg, rsh, rc, can,
+                        fmask, nbpf, is_cat,
+                        prm.min_data_in_leaf,
+                        prm.min_sum_hessian_in_leaf,
+                        prm.lambda_l1, prm.lambda_l2,
+                        prm.min_gain_to_split,
+                        interpret=_interp,
+                    )
+                if use_kernel and hl.dtype == jnp.float32:
+                    from ..ops.pallas_search import search2_pallas
+
+                    return search2_pallas(
+                        hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
+                        fmask, nbpf, is_cat,
+                        prm.min_data_in_leaf,
+                        prm.min_sum_hessian_in_leaf,
+                        prm.lambda_l1, prm.lambda_l2,
+                        prm.min_gain_to_split,
+                    )
                 res = find_best_split_leaves(
                     jnp.stack([hl, hr]),
                     jnp.stack([lsg, rsg]),
@@ -336,6 +385,10 @@ def grow_tree(
                     SplitResult(*[a[0] for a in res]),
                     SplitResult(*[a[1] for a in res]),
                 )
+    if opt:
+        # every in-loop histogram (children + pooled parent recompute)
+        # is built in the raw layout
+        hist_fn = hist_fn_raw
     if child_counts_fn is None:
         _sum = (lambda x: x) if reduce_fn is None else reduce_fn
         _max = (lambda x: x) if reduce_max_fn is None else reduce_max_fn
@@ -477,7 +530,13 @@ def grow_tree(
             best=_set_best(
                 _empty_best(L, acc_dt),
                 0,
-                best_for(hist0, sum_g0, sum_h0, cnt0, jnp.int32(0)),
+                best_for(
+                    # raw-layout root histogram -> canonical view for
+                    # the (once-per-tree) jnp root search
+                    hist0[:F, :3, :num_bins].transpose(0, 2, 1)
+                    if opt else hist0,
+                    sum_g0, sum_h0, cnt0, jnp.int32(0),
+                ),
             ),
             tree=empty_tree(L),
         )

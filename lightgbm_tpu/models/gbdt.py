@@ -187,6 +187,7 @@ class GBDT:
                 max_leaves=self.max_leaves,
                 hist_fn=self._leafwise_hist_fn(),
                 hist_pool=self._hist_pool_slots(),
+                hist_fn_raw=self._leafwise_hist_fn_raw(),
             )
         from ..parallel import (
             data_mesh,
@@ -260,7 +261,18 @@ class GBDT:
             )
             return 0
         itemsize = 8 if self._use_f64_hist else 4
-        per_leaf = int(self._bins_T.shape[0]) * self._num_bins * 3 * itemsize
+        F = int(self._bins_T.shape[0])
+        if self._leafwise_hist_fn_raw() is not None:
+            # raw-layout residency: each slot is the PADDED kernel-native
+            # [Fp, 4, Bp] buffer, not F*num_bins*3.  (Parallel learners
+            # keep the canonical layout; sizing them by the larger raw
+            # slot just errs on the safe side of the MB bound.)
+            from ..ops.pallas_histogram import FGROUP, _pad_pow
+
+            Fp = ((F + FGROUP - 1) // FGROUP) * FGROUP
+            per_leaf = Fp * 4 * _pad_pow(self._num_bins) * itemsize
+        else:
+            per_leaf = F * self._num_bins * 3 * itemsize
         slots = int(mb * 1024 * 1024 / max(per_leaf, 1))
         return max(2, min(slots, self.max_leaves))
 
@@ -287,6 +299,26 @@ class GBDT:
 
             return select_single_hist_fn(self._num_bins, True)
         return None  # grower's default segment_sum path
+
+    def _leafwise_hist_fn_raw(self):
+        """Raw-layout ([Fp, 4, Bp]) single-leaf kernel for the serial
+        leaf-wise opt path: the split step then never leaves the
+        histogram kernel's native layout (grow_tree ``opt`` mode).
+        v1-variant TPU only; LGBM_TPU_OPT_HISTS=0 disables."""
+        import os
+
+        from ..ops.pallas_histogram import _kernel_variant
+
+        if (
+            self._use_pallas_hist()
+            and jax.default_backend() == "tpu"
+            and _kernel_variant() == "v1"
+            and os.environ.get("LGBM_TPU_OPT_HISTS", "1") != "0"
+        ):
+            from ..ops.pallas_histogram import make_single_hist_fn_raw
+
+            return make_single_hist_fn_raw(self._num_bins)
+        return None
 
     def _depthwise_hist_fn(self):
         """Histogram implementation for depthwise growth (config.hist_impl):

@@ -157,12 +157,18 @@ def _pad_pow(b: int) -> int:
 
 def _hist_pallas_call(
     leaf_of_chunk, bins_buf, stats_buf, out_leaves, Fp, B, C, n_chunks,
-    interpret, variant=None,
+    interpret, variant=None, raw=False,
 ):
     """Shared pallas_call scaffolding for both kernels: one grid step per
     C-row chunk, output block indexed by the scalar-prefetched
     chunk->leaf map.  Returns hist[out_leaves, Fp, B, 4] in the
-    CANONICAL bin-major layout whichever kernel variant ran."""
+    CANONICAL bin-major layout whichever kernel variant ran — or, with
+    ``raw=True`` (v1 only), the kernel's NATIVE [out_leaves, Fp, 4, B]
+    layout with no relayout at all: the round-3 profile showed the
+    per-split transpose to the canonical layout radiating ~0.5 ms/split
+    of layout-churn fusions through the whole split step."""
+    if raw:
+        assert _kernel_variant(variant) == "v1", "raw layout is v1-only"
     if _kernel_variant(variant) == "v1":
         kernel = functools.partial(_hist_kernel_v1, num_f=Fp, num_b=B, chunk=C)
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -182,6 +188,8 @@ def _hist_pallas_call(
             out_shape=jax.ShapeDtypeStruct((out_leaves, Fp, 4, B), jnp.float32),
             interpret=interpret,
         )(leaf_of_chunk, bins_buf, stats_buf)
+        if raw:
+            return out  # [L, Fp, 4, B] kernel-native
         return out.transpose(0, 1, 3, 2)  # -> [L, Fp, B, 4]
 
     # bsub: feature groups ride the OUTER grid axis (chunk minor), so the
@@ -306,12 +314,24 @@ def histogram_single_leaf(
     scatter — just O(cap x B x F) dense MACs.
     """
     F, cap = bins_T.shape
-    # the block width must stay lane-aligned whatever cap is — an
-    # unaligned int8 block is the Mosaic failure class the FGROUP loop
-    # exists to avoid
+    fg = FGROUP if _kernel_variant(variant) == "v1" else FGROUP_BSUB
+    bins_T, stats, n_chunks, Fp, B, C = _prep_single_leaf(
+        bins_T, grad, hess, mask, num_bins, chunk, fg)
+    out = _hist_pallas_call(
+        jnp.zeros(n_chunks, jnp.int32), bins_T, stats, 1, Fp, B, C,
+        n_chunks, interpret, variant,
+    )  # [1, Fp, B, 4]
+    return out[0, :F, :num_bins, :3]
+
+
+def _prep_single_leaf(bins_T, grad, hess, mask, num_bins, chunk, fg):
+    """Shared single-leaf padding/stat prep: lane-aligned chunk width
+    (an unaligned int8 block is the Mosaic failure class the FGROUP
+    loop exists to avoid), features padded to the kernel grouping, and
+    the (g*m, h*m, m, 0) stat stack."""
+    F, cap = bins_T.shape
     C = max(128, (chunk // 128) * 128)
     B = _pad_pow(num_bins)
-    fg = FGROUP if _kernel_variant(variant) == "v1" else FGROUP_BSUB
     Fp = ((F + fg - 1) // fg) * fg
     if Fp != F:
         bins_T = jnp.pad(bins_T, ((0, Fp - F), (0, 0)))
@@ -321,19 +341,52 @@ def histogram_single_leaf(
         grad = jnp.pad(grad, (0, pad))
         hess = jnp.pad(hess, (0, pad))
         mask = jnp.pad(mask, (0, pad))
-    n_chunks = (cap + pad) // C
-
     gm = grad * mask
     hm = hess * mask
     stats = jnp.stack(
         [gm, hm, mask, jnp.zeros_like(mask)], axis=-1
     ).astype(jnp.float32)
+    return bins_T, stats, (cap + pad) // C, Fp, B, C
 
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "interpret")
+)
+def histogram_single_leaf_raw(
+    bins_T: jax.Array,  # [F, cap] binned rows of ONE leaf (masked)
+    grad: jax.Array,  # [cap]
+    hess: jax.Array,  # [cap]
+    mask: jax.Array,  # [cap] 0/1 validity
+    num_bins: int,
+    chunk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """histogram_single_leaf in the KERNEL-NATIVE [Fp, 4, Bp] layout
+    (stat rows g/h/count/zero, bins in lanes, features padded to the
+    v1 grouping) — zero post-processing, so the whole split step can
+    stay in one layout (see _hist_pallas_call raw)."""
+    bins_T, stats, n_chunks, Fp, B, C = _prep_single_leaf(
+        bins_T, grad, hess, mask, num_bins, chunk, FGROUP)
     out = _hist_pallas_call(
         jnp.zeros(n_chunks, jnp.int32), bins_T, stats, 1, Fp, B, C,
-        n_chunks, interpret, variant,
-    )  # [1, Fp, B, 4]
-    return out[0, :F, :num_bins, :3]
+        n_chunks, interpret, variant="v1", raw=True,
+    )  # [1, Fp, 4, B]
+    return out[0]
+
+
+@functools.lru_cache(maxsize=None)
+def make_single_hist_fn_raw(num_bins: int, chunk: int = 512):
+    """hist_fn for the leaf-wise grower's RAW-layout path (signature:
+    bins_T, grad, hess, mask -> [Fp, 4, Bp])."""
+    interpret = jax.default_backend() != "tpu"
+
+    def hist_fn(bins_T, grad, hess, mask):
+        return histogram_single_leaf_raw(
+            bins_T, grad, hess, mask,
+            num_bins=num_bins, chunk=chunk, interpret=interpret,
+        )
+
+    return hist_fn
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,329 @@
+"""Pallas TPU kernel for the per-split best-threshold search.
+
+The round-3 on-chip profile (tools/profile_split.py, BASELINE.md) showed
+the leaf-wise loop bound by PER-OP overhead, not data volume: the jnp
+split search compiles to ~60 small [F, B]-shaped fusions per split
+(~1.6 ms), 4x the histogram kernel itself, and no jnp-level
+restructuring escapes the per-op cost (batching the two children into
+[2, F, B] ops left the steady state unchanged at ~0.95 s/tree).  This
+kernel runs the ENTIRE two-child search — suffix sums, gain grid,
+validity masking, deterministic (feature asc, bin desc) winner
+selection, and winner-stat extraction — as ONE launch.
+
+Design notes:
+
+* Mosaic wants (sublane, lane) register shapes, so the kernel works in
+  STRICTLY rank-2 arrays: the two children's [F, B, 3] histograms are
+  pre-flattened to one [6F, B] operand (child-major, then stat, then
+  feature), the two children unroll as Python iterations, scalars stay
+  [1, 1] slices, and feature metadata arrives pre-transposed as [F, 4].
+* Suffix sums ride the MXU: tail[t] = sum_{b>t} h[b] is one dot with
+  the strict upper-triangular ones matrix at precision=HIGHEST
+  (f32-accurate bf16 passes) — no reliance on a Mosaic cumsum lowering.
+* The deterministic tie-break reproduces ops/split.py exactly under
+  exact float equality: per feature the LARGEST threshold among
+  equal-gain maxima, across features the SMALLEST feature index
+  (split_info.hpp:98-103 semantics).
+* Outputs are a [2, 16] f32 row pair (gain, feature, threshold, six
+  stats, two leaf outputs); the host-side wrapper casts feature and
+  threshold back to int32 and rebuilds the two SplitResults.
+
+The jnp path in ops/split.py remains the reference implementation (and
+the CPU / float64 path); tests pin this kernel against it in interpret
+mode, including crafted exact ties.  Reference scan being replaced:
+FeatureHistogram::FindBestThreshold* (feature_histogram.hpp:116-246).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .split import SplitResult, K_EPSILON
+
+NEG = -3.4e38  # "no split" sentinel (python float on purpose: a jnp
+# scalar would be a captured constant inside the kernel)
+BIG = 2**30
+
+
+def _tri(B):
+    """Strict upper-triangular ones: tri[b, t] = 1.0 iff b > t."""
+    bi = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+    ti = jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
+    return (bi > ti).astype(jnp.float32)
+
+
+def _child_search(c, hg, hh, hc, tg, th, tc, scal_ref, meta_ref, out_ref,
+                  F, B):
+    """One child's full search given its stat planes [F, B] and their
+    exclusive suffix sums; writes the child's [1, 16] result row.
+
+    Mosaic-friendly shapes only: [F, B] / [F, 1] vectors, TRUE scalars
+    from the SMEM-prefetched ``scal_ref`` (scalar splats broadcast
+    freely; [1,1]->[F,B] tensor broadcasts do not on this stack), and
+    scalar full-array reduces for the winner selection.
+    """
+    fmask = meta_ref[:, 0:1] > 0  # [F, 1]
+    nb = meta_ref[:, 1:2]  # [F, 1]
+    iscat = meta_ref[:, 2:3] > 0  # [F, 1]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (F, B), 1)
+    # pure logical ops, not where-on-bools: Mosaic cannot truncate the
+    # i8 select result back to i1
+    in_range = ((iscat & (bins < nb)) | (~iscat & (bins < nb - 1))) & fmask
+    fi = jax.lax.broadcasted_iota(jnp.int32, (F, 1), 0)
+    lane16 = jax.lax.broadcasted_iota(jnp.int32, (1, 16), 1)
+
+    min_data = scal_ref[8]
+    min_hess = scal_ref[9]
+    l1 = scal_ref[10]
+    l2 = scal_ref[11]
+    min_gain = scal_ref[12]
+
+    def leaf_gain(sg, sh):
+        reg = jnp.maximum(jnp.abs(sg) - l1, 0.0)
+        return reg * reg / (sh + l2)
+
+    can = scal_ref[4 * c + 0] > 0.0  # scalar bool
+    sg_t = scal_ref[4 * c + 1]
+    sh_t = scal_ref[4 * c + 2]
+    cnt_t = scal_ref[4 * c + 3]
+
+    left_g = jnp.where(iscat, hg, sg_t - tg)
+    left_h = jnp.where(iscat, hh, sh_t - th)
+    left_c = jnp.where(iscat, hc, cnt_t - tc)
+    right_g = jnp.where(iscat, sg_t - hg, tg)
+    right_h = jnp.where(iscat, sh_t - hh, th)
+    right_c = jnp.where(iscat, cnt_t - hc, tc)
+
+    gain_shift = leaf_gain(sg_t, sh_t)  # scalar
+    gains = leaf_gain(left_g, left_h) + leaf_gain(right_g, right_h)
+    valid = (
+        in_range
+        & (left_c >= min_data) & (right_c >= min_data)
+        & (left_h >= min_hess) & (right_h >= min_hess)
+        & (gains >= gain_shift + min_gain)
+        & can
+    )
+    score = jnp.where(valid, gains, NEG)  # [F, B]
+
+    # deterministic winner: global max; largest t per feature among
+    # maxima; smallest such feature
+    maxg = jnp.max(score)  # scalar
+    at_max = (score == maxg) & valid
+    tbest = jnp.max(jnp.where(at_max, bins, -1), axis=1,
+                    keepdims=True)  # [F, 1]
+    fbest = jnp.min(jnp.where(tbest >= 0, fi, BIG))  # scalar
+    thr = jnp.max(jnp.where(fi == fbest, tbest, -1))  # scalar
+
+    sel = (fi == fbest) & (bins == thr)  # [F, B]
+
+    def pick(x):
+        return jnp.sum(jnp.where(sel, x, 0.0))  # scalar
+
+    lg, lh, lc = pick(left_g), pick(left_h), pick(left_c)
+    rg, rh, rc = pick(right_g), pick(right_h), pick(right_c)
+
+    def leaf_out(sg, sh):
+        reg = jnp.maximum(jnp.abs(sg) - l1, 0.0)
+        return -jnp.sign(sg) * reg / (sh + l2)
+
+    ok = maxg > NEG  # scalar bool
+    vals = [
+        jnp.where(ok, maxg - gain_shift, -jnp.inf),
+        jnp.where(ok, fbest, -1).astype(jnp.float32),
+        jnp.where(ok, thr, 0).astype(jnp.float32),
+        lg, lh, lc, rg, rh, rc,
+        leaf_out(lg, lh), leaf_out(rg, rh),
+    ]
+    # assemble the [1, 16] row with lane selects (scalar splats are
+    # the one broadcast form this Mosaic supports everywhere)
+    row = jnp.zeros((1, 16), jnp.float32)
+    for j, v in enumerate(vals):
+        row = jnp.where(lane16 == j, v, row)
+    out_ref[c:c + 1, :] = row
+
+
+def _search2_kernel(scal_ref, hist_ref, meta_ref, out_ref, *, F, B):
+    """One grid step: both children end-to-end.
+
+    scal_ref [16]    f32 SMEM  (canL, lsg, lsh, lc, canR, rsg, rsh, rc,
+                                min_data, min_hess, l1, l2, min_gain)
+    hist_ref [6F, B] f32       child-major [c, s, f] rows: g, h, count
+    meta_ref [F, 4]  i32       (feature_mask, nbpf, is_categorical, pad)
+    out_ref  [2, 16] f32
+    """
+    h = hist_ref[...]  # [6F, B]
+    # tail[row, t] = sum_{b > t} h[row, b] for ALL six (child, stat) rows
+    tail = jax.lax.dot_general(
+        h, _tri(B), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [6F, B]
+    for c in range(2):
+        base = c * 3 * F
+        _child_search(
+            c,
+            h[base:base + F], h[base + F:base + 2 * F],
+            h[base + 2 * F:base + 3 * F],
+            tail[base:base + F],
+            tail[base + F:base + 2 * F] + K_EPSILON,  # kEpsilon seed
+            tail[base + 2 * F:base + 3 * F],
+            scal_ref, meta_ref, out_ref, F, B,
+        )
+
+
+def _search2_kernel_raw(scal_ref, hist_ref, meta_ref, out_ref, *, F, B):
+    """Raw-layout variant: hist_ref [2, F, 4, B] is the histogram
+    buffer's KERNEL-NATIVE layout (ops/pallas_histogram raw path), so
+    the split step never converts layouts.  Stat planes come from
+    static rank-4 indexing (supported by this Mosaic); everything else
+    is the shared per-child search."""
+    h = hist_ref[...]  # [2, F, 4, B]
+    tri = _tri(B)
+
+    for c in range(2):
+        hg, hh, hc = h[c, :, 0, :], h[c, :, 1, :], h[c, :, 2, :]
+
+        def tail_of(x):
+            return jax.lax.dot_general(
+                x, tri, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+
+        _child_search(
+            c, hg, hh, hc,
+            tail_of(hg), tail_of(hh) + K_EPSILON, tail_of(hc),
+            scal_ref, meta_ref, out_ref, F, B,
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def search2_pallas(
+    h_left, h_right,  # [F, B, 3] f32
+    lsg, lsh, lc, rsg, rsh, rc,  # scalars
+    can,  # scalar bool (shared by both children: same depth)
+    feature_mask, num_bins_per_feature, is_categorical,  # [F]
+    min_data_in_leaf, min_sum_hessian_in_leaf,
+    lambda_l1, lambda_l2, min_gain_to_split,
+    interpret: bool = False,
+):
+    """Both children's best splits in one kernel launch; returns two
+    scalar SplitResults matching ops/split.find_best_split bit-for-bit
+    up to the suffix-sum accumulation order (MXU triangular dot vs
+    sequential cumsum — identical under exact arithmetic)."""
+    F, B, _ = h_left.shape
+    hist = (
+        jnp.stack([h_left, h_right])  # [2, F, B, 3]
+        .transpose(0, 3, 1, 2)  # [2, 3, F, B] child-major, stat, feature
+        .reshape(6 * F, B)
+        .astype(jnp.float32)
+    )
+    meta = jnp.stack([
+        feature_mask.astype(jnp.int32),
+        num_bins_per_feature.astype(jnp.int32),
+        is_categorical.astype(jnp.int32),
+        jnp.zeros(F, jnp.int32),
+    ], axis=1)  # [F, 4]
+    scal = _pack_scal(
+        jnp.asarray(can, jnp.float32), lsg, lsh, lc, rsg, rsh, rc,
+        min_data_in_leaf, min_sum_hessian_in_leaf,
+        lambda_l1, lambda_l2, min_gain_to_split)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((6 * F, B), lambda i, s: (0, 0)),
+            pl.BlockSpec((F, 4), lambda i, s: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, 16), lambda i, s: (0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_search2_kernel, F=F, B=B),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((2, 16), jnp.float32),
+        interpret=interpret,
+    )(scal, hist, meta)
+
+    return _unpack(out, 0), _unpack(out, 1)
+
+
+def _unpack(out, i):
+    row = out[i]
+    return SplitResult(
+        gain=row[0],
+        feature=row[1].astype(jnp.int32),
+        threshold=row[2].astype(jnp.int32),
+        left_sum_grad=row[3],
+        left_sum_hess=row[4],
+        left_count=row[5],
+        right_sum_grad=row[6],
+        right_sum_hess=row[7],
+        right_count=row[8],
+        left_output=row[9],
+        right_output=row[10],
+    )
+
+
+def _pack_scal(canf, lsg, lsh, lc, rsg, rsh, rc,
+               min_data, min_hess, l1, l2, min_gain):
+    f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
+    return jnp.stack([
+        canf, f32(lsg), f32(lsh), f32(lc),
+        canf, f32(rsg), f32(rsh), f32(rc),
+        f32(min_data), f32(min_hess), f32(l1), f32(l2), f32(min_gain),
+        f32(0), f32(0), f32(0),
+    ])  # [16] SMEM scalar-prefetch
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def search2_pallas_raw(
+    h2,  # [2, Fp, 4, Bp] f32 — the raw-layout histogram rows
+    lsg, lsh, lc, rsg, rsh, rc,  # scalars
+    can,  # scalar bool
+    feature_mask, num_bins_per_feature, is_categorical,  # [F] (unpadded)
+    min_data_in_leaf, min_sum_hessian_in_leaf,
+    lambda_l1, lambda_l2, min_gain_to_split,
+    interpret: bool = False,
+):
+    """search2_pallas over kernel-native [2, Fp, 4, Bp] histogram rows:
+    no layout conversion anywhere between the histogram kernel, the
+    subtract trick, and this search.  Padded features are masked out
+    via the padded feature_mask; padded bins exceed nbpf and never
+    validate."""
+    _, Fp, _, Bp = h2.shape
+    F = feature_mask.shape[0]
+    meta = jnp.stack([
+        feature_mask.astype(jnp.int32),
+        num_bins_per_feature.astype(jnp.int32),
+        is_categorical.astype(jnp.int32),
+        jnp.zeros(F, jnp.int32),
+    ], axis=1)  # [F, 4]
+    if Fp != F:
+        meta = jnp.pad(meta, ((0, Fp - F), (0, 0)))  # fmask=0 on pads
+    scal = _pack_scal(
+        jnp.asarray(can, jnp.float32), lsg, lsh, lc, rsg, rsh, rc,
+        min_data_in_leaf, min_sum_hessian_in_leaf,
+        lambda_l1, lambda_l2, min_gain_to_split)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((2, Fp, 4, Bp), lambda i, s: (0, 0, 0, 0)),
+            pl.BlockSpec((Fp, 4), lambda i, s: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, 16), lambda i, s: (0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_search2_kernel_raw, F=Fp, B=Bp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((2, 16), jnp.float32),
+        interpret=interpret,
+    )(scal, h2.astype(jnp.float32), meta)
+    return _unpack(out, 0), _unpack(out, 1)

@@ -1,0 +1,99 @@
+"""The raw-layout opt path (grow_tree ``opt`` mode: raw [Fp, 4, Bp]
+histogram kernel + raw Pallas search, both in interpret mode on CPU)
+must grow the same trees as the canonical [F, B, 3] path."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.learners.serial import grow_tree, TreeLearnerParams
+from lightgbm_tpu.ops.pallas_histogram import histogram_single_leaf_raw
+
+
+def params(min_data=1, min_hess=0.0, l1=0.0, l2=0.0, min_gain=0.0,
+           max_depth=-1):
+    return TreeLearnerParams(
+        jnp.float32(min_data), jnp.float32(min_hess), jnp.float32(l1),
+        jnp.float32(l2), jnp.float32(min_gain), jnp.int32(max_depth))
+
+
+def _raw_hist_fn(num_bins):
+    def fn(bins_T, grad, hess, mask):
+        return histogram_single_leaf_raw(
+            bins_T, grad, hess, mask, num_bins=num_bins, interpret=True)
+    return fn
+
+
+def _grow(bins, grad, hess, num_bins, raw, max_leaves=16, bag=None,
+          is_cat=None, pool=0, **kw):
+    n, F = bins.shape
+    return grow_tree(
+        jnp.asarray(bins.T.astype(np.uint8)),
+        jnp.asarray(grad, jnp.float32),
+        jnp.asarray(hess, jnp.float32),
+        jnp.ones(n, jnp.float32) if bag is None else jnp.asarray(
+            bag, jnp.float32),
+        jnp.ones(F, bool),
+        jnp.full(F, num_bins, jnp.int32),
+        jnp.zeros(F, bool) if is_cat is None else jnp.asarray(is_cat, bool),
+        params(**kw),
+        num_bins=num_bins,
+        max_leaves=max_leaves,
+        hist_pool=pool,
+        hist_fn_raw=_raw_hist_fn(num_bins) if raw else None,
+    )
+
+
+def _mk(n=4000, F=7, num_bins=23, seed=0):
+    """Integer-valued grad/hess: histogram partial sums are then exact
+    in f32 under ANY accumulation order, so the opt path (MXU
+    triangular-dot suffix sums) and the canonical path (sequential
+    reverse cumsum) compute bitwise-identical gains and must grow
+    IDENTICAL trees — no tolerance needed, no near-tie flakiness."""
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, num_bins, (n, F))
+    grad = rng.randint(-8, 9, n).astype(np.float32)
+    hess = rng.randint(1, 5, n).astype(np.float32)
+    return bins, grad, hess
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_opt_matches_canonical(seed):
+    bins, grad, hess = _mk(seed=seed)
+    t0, l0 = _grow(bins, grad, hess, 23, raw=False)
+    t1, l1 = _grow(bins, grad, hess, 23, raw=True)
+    assert int(t0.num_leaves) == int(t1.num_leaves) > 4
+    np.testing.assert_array_equal(
+        np.asarray(t0.split_feature), np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(
+        np.asarray(t0.threshold_bin), np.asarray(t1.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    np.testing.assert_allclose(
+        np.asarray(t0.leaf_value), np.asarray(t1.leaf_value),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_opt_with_bagging_and_categorical():
+    bins, grad, hess = _mk(seed=1)
+    rng = np.random.RandomState(7)
+    bag = (rng.rand(len(grad)) < 0.7).astype(np.float32)
+    is_cat = np.zeros(bins.shape[1], bool)
+    is_cat[2] = True
+    t0, l0 = _grow(bins, grad, hess, 23, raw=False, bag=bag, is_cat=is_cat,
+                   min_data=5)
+    t1, l1 = _grow(bins, grad, hess, 23, raw=True, bag=bag, is_cat=is_cat,
+                   min_data=5)
+    np.testing.assert_array_equal(
+        np.asarray(t0.split_feature), np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(
+        np.asarray(t0.threshold_bin), np.asarray(t1.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+
+
+def test_opt_with_hist_pool():
+    bins, grad, hess = _mk(seed=2)
+    t0, l0 = _grow(bins, grad, hess, 23, raw=False, pool=4)
+    t1, l1 = _grow(bins, grad, hess, 23, raw=True, pool=4)
+    np.testing.assert_array_equal(
+        np.asarray(t0.split_feature), np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))

@@ -1,0 +1,131 @@
+"""Pin the Pallas split-search kernel against the jnp reference
+(ops/split.find_best_split) in interpret mode.
+
+The kernel's suffix sums ride a triangular matmul whose accumulation
+order differs from jnp.cumsum, so float gains can differ by ulps on a
+real chip; in interpret mode with integer-valued histograms every
+quantity is exact and the comparison is bit-for-bit — including the
+deterministic (feature asc, bin desc) tie-break.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.pallas_search import search2_pallas
+from lightgbm_tpu.ops.split import find_best_split
+
+
+def _ref(hist, sg, sh, c, fmask, nbpf, iscat, can=True, **kw):
+    p = dict(min_data_in_leaf=jnp.float32(kw.get("min_data", 1.0)),
+             min_sum_hessian_in_leaf=jnp.float32(kw.get("min_hess", 0.0)),
+             lambda_l1=jnp.float32(kw.get("l1", 0.0)),
+             lambda_l2=jnp.float32(kw.get("l2", 1.0)),
+             min_gain_to_split=jnp.float32(kw.get("min_gain", 0.0)))
+    return find_best_split(
+        jnp.asarray(hist), jnp.float32(sg), jnp.float32(sh),
+        jnp.float32(c), jnp.asarray(fmask), jnp.asarray(nbpf),
+        jnp.asarray(iscat), p["min_data_in_leaf"],
+        p["min_sum_hessian_in_leaf"], p["lambda_l1"], p["lambda_l2"],
+        p["min_gain_to_split"], jnp.asarray(can))
+
+
+def _kernel(hl, hr, totl, totr, fmask, nbpf, iscat, can=True, **kw):
+    return search2_pallas(
+        jnp.asarray(hl), jnp.asarray(hr),
+        jnp.float32(totl[0]), jnp.float32(totl[1]), jnp.float32(totl[2]),
+        jnp.float32(totr[0]), jnp.float32(totr[1]), jnp.float32(totr[2]),
+        jnp.asarray(can),
+        jnp.asarray(fmask), jnp.asarray(nbpf), jnp.asarray(iscat),
+        jnp.float32(kw.get("min_data", 1.0)),
+        jnp.float32(kw.get("min_hess", 0.0)),
+        jnp.float32(kw.get("l1", 0.0)), jnp.float32(kw.get("l2", 1.0)),
+        jnp.float32(kw.get("min_gain", 0.0)),
+        interpret=True)
+
+
+def _mk(F=9, B=31, seed=0, ints=False, cat_mask=None):
+    rng = np.random.RandomState(seed)
+    if ints:
+        g = rng.randint(-8, 9, (F, B)).astype(np.float32)
+        h = rng.randint(1, 5, (F, B)).astype(np.float32)
+        c = rng.randint(1, 5, (F, B)).astype(np.float32)
+    else:
+        g = rng.randn(F, B).astype(np.float32)
+        h = np.abs(rng.randn(F, B)).astype(np.float32) + 0.1
+        c = rng.randint(1, 50, (F, B)).astype(np.float32)
+    hist = np.stack([g, h, c], axis=-1)
+    fmask = np.ones(F, bool)
+    nbpf = np.full(F, B, np.int32)
+    iscat = np.zeros(F, bool) if cat_mask is None else cat_mask
+    tot = (g.sum(), h.sum(), c.sum())
+    return hist, tot, fmask, nbpf, iscat
+
+
+def _assert_same(res, ref, exact):
+    assert int(res.feature) == int(ref.feature)
+    assert int(res.threshold) == int(ref.threshold)
+    if exact:
+        for a, b in zip(res, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    else:
+        for a, b in zip(res, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_matches_reference_random(seed):
+    hl, totl, fmask, nbpf, iscat = _mk(seed=seed)
+    hr, totr, *_ = _mk(seed=seed + 100)
+    rl, rr = _kernel(hl, hr, totl, totr, fmask, nbpf, iscat)
+    el = _ref(hl, *totl, fmask, nbpf, iscat)
+    er = _ref(hr, *totr, fmask, nbpf, iscat)
+    _assert_same(rl, el, exact=False)
+    _assert_same(rr, er, exact=False)
+
+
+def test_tie_break_feature_asc_bin_desc():
+    # integer-valued stats: both paths compute identical floats, so the
+    # crafted ties are EXACT ties and must resolve (feature asc, bin
+    # desc) like the reference scan
+    hl, totl, fmask, nbpf, iscat = _mk(ints=True, seed=7)
+    # make feature 2 the clear gain winner (big |grad|, unit hess),
+    # then duplicate it at feature 6: an EXACT cross-feature tie
+    hl[2, :, 0] = np.where(np.arange(hl.shape[1]) < 16, 32.0, -32.0)
+    hl[2, :, 1] = 1.0
+    hl[2, :, 2] = 4.0
+    hl[6] = hl[2]
+    totl = (hl[2, :, 0].sum(), hl[2, :, 1].sum(), hl[2, :, 2].sum())
+    el = _ref(hl, *totl, fmask, nbpf, iscat)
+    rl, _ = _kernel(hl, hl, totl, totl, fmask, nbpf, iscat)
+    assert int(el.feature) == 2  # smallest feature wins the exact tie
+    assert int(el.feature) == int(rl.feature)
+    _assert_same(rl, el, exact=True)
+
+
+def test_categorical_and_masks():
+    cat = np.zeros(9, bool)
+    cat[3] = True
+    hl, totl, fmask, nbpf, iscat = _mk(ints=True, seed=11, cat_mask=cat)
+    fmask = fmask.copy()
+    fmask[0] = False
+    rl, rr = _kernel(hl, hl, totl, totl, fmask, nbpf, iscat,
+                     min_data=3.0, min_hess=2.0, l1=0.5, l2=2.0)
+    el = _ref(hl, *totl, fmask, nbpf, iscat,
+              min_data=3.0, min_hess=2.0, l1=0.5, l2=2.0)
+    _assert_same(rl, el, exact=True)
+    _assert_same(rr, el, exact=True)
+
+
+def test_no_valid_split():
+    hl, totl, fmask, nbpf, iscat = _mk(seed=5)
+    rl, rr = _kernel(hl, hl, totl, totl, fmask, nbpf, iscat,
+                     min_data=1e9)
+    el = _ref(hl, *totl, fmask, nbpf, iscat, min_data=1e9)
+    assert int(rl.feature) == int(el.feature) == -1
+    assert not np.isfinite(float(rl.gain))
+    # can_split=False must also kill both children
+    rl2, rr2 = _kernel(hl, hl, totl, totl, fmask, nbpf, iscat, can=False)
+    assert int(rl2.feature) == -1 and int(rr2.feature) == -1
