@@ -274,6 +274,11 @@ def ours_sec_per_tree(X, y, growth: str) -> tuple[float, float]:
         log(f"binning: {time.perf_counter() - t0:.1f}s")
         _DATASET_CACHE["ds"] = ds
     obj = create_objective(cfg, ds.metadata, ds.num_data)
+    # lagged stop check: the eager per-iter int(num_leaves) sync drains
+    # the dispatch pipeline over the tunnel (~0.3 s/tree at 1M rows);
+    # the lagged mode rolls back to an identical final model if the
+    # no-split terminal state ever fires (it never does at bench scale)
+    os.environ.setdefault("LGBM_TPU_STOP_LAG", "4")
     booster = GBDT(cfg, ds, obj)
 
     # warmup: first iteration compiles.  If the Pallas histogram path

@@ -323,6 +323,7 @@ def grow_tree(
     import os as _os
 
     _kern_env = _os.environ.get("LGBM_TPU_SEARCH_KERNEL", "pallas") != "jnp"
+    _interp = jax.default_backend() != "tpu"
     opt = (
         hist_fn_raw is not None
         and search_fn is None
@@ -333,11 +334,14 @@ def grow_tree(
         # LGBM_TPU_SEARCH_KERNEL=jnp escape hatch disables opt wholesale
         and _kern_env
     )
+    # fused split step (subtract + search + in-place buffer update in
+    # one launch) — unpooled only: the left child reuses the parent's
+    # buffer row
+    opt_fused = opt and not (0 < hist_pool < max_leaves)
     if search_fn is None:
         search_fn = default_search_fn
         if search2_fn is None:
             use_kernel = jax.default_backend() == "tpu" and _kern_env
-            _interp = jax.default_backend() != "tpu"
 
             def search2_fn(hl, hr, lsg, lsh, lc, rsg, rsh, rc, can,
                            fmask, nbpf, is_cat, prm):
@@ -389,6 +393,26 @@ def grow_tree(
         # every in-loop histogram (children + pooled parent recompute)
         # is built in the raw layout
         hist_fn = hist_fn_raw
+    if opt_fused:
+        # record mode: the loop state carries the leaf-sorted PACKED
+        # RECORD [W, n_pad] (ops/record.py) instead of the row
+        # permutation — every per-split access becomes a contiguous
+        # slice and the partition runs as the MXU block-compaction
+        # kernel.  The round-3 profile showed the order-based path's
+        # per-index gathers/scatters costing ~0.4 s/tree at 1M rows.
+        from ..ops.record import (
+            TILE as _REC_TILE,
+            bins_per_word, build_record, extract_feature, num_words,
+            partition_window, rec_height, unpack_window,
+        )
+
+        k_pack = bins_per_word(bins_T.dtype)
+        Wrec = rec_height(F, k_pack)
+        _row_id_row = num_words(F, k_pack) + 3
+        bin_dt = bins_T.dtype
+        h_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in h_tiers}))
+        p_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in p_tiers}))
+        order_pad = max(p_tiers + h_tiers)
     if child_counts_fn is None:
         _sum = (lambda x: x) if reduce_fn is None else reduce_fn
         _max = (lambda x: x) if reduce_max_fn is None else reduce_max_fn
@@ -507,7 +531,14 @@ def grow_tree(
         start_step = K0 - 1
     else:
         state = _GrowState(
-            order=jnp.concatenate(
+            # record mode: the "order" leaf carries the [W, n_pad]
+            # packed record; otherwise the flat row permutation
+            order=build_record(
+                bins_T, grad, hess, bag_mask,
+                _round_up(n, _REC_TILE) + order_pad,
+            )
+            if opt_fused
+            else jnp.concatenate(
                 [
                     jnp.arange(n, dtype=jnp.int32),
                     jnp.full(order_pad, n, jnp.int32),
@@ -564,13 +595,28 @@ def grow_tree(
         # here.
         begin = state.leaf_begin[best_leaf]
         pcnt = state.pos_cnt[best_leaf]
-        order, nleft = _tier_chain(
-            p_tiers,
-            state.gate_cnt[best_leaf],
-            lambda cap: _partition_branch(
-                state.order, bins_T, f, thr, is_cat, begin, pcnt, do_split, cap
-            ),
-        )
+        if opt_fused:
+
+            def _part_rec(cap):
+                fv = extract_feature(state.order, f, begin, cap, k_pack)
+                go = jnp.where(is_cat, fv == thr, fv <= thr)
+                return partition_window(
+                    state.order, go, begin, pcnt, do_split, cap,
+                    interpret=_interp,
+                )
+
+            order, nleft = _tier_chain(
+                p_tiers, state.gate_cnt[best_leaf], _part_rec
+            )
+        else:
+            order, nleft = _tier_chain(
+                p_tiers,
+                state.gate_cnt[best_leaf],
+                lambda cap: _partition_branch(
+                    state.order, bins_T, f, thr, is_cat, begin, pcnt,
+                    do_split, cap
+                ),
+            )
         nright = pcnt - nleft
         leaf_begin = state.leaf_begin.at[new_leaf].set(
             jnp.where(do_split, begin + nleft, state.leaf_begin[new_leaf])
@@ -610,14 +656,30 @@ def grow_tree(
         cnt_s = jnp.where(small_is_left, nleft, nright)
         cnt_s_gate = jnp.where(small_is_left, nleft_gate, nright_gate)
         begin_s = jnp.where(small_is_left, begin, begin + nleft)
-        h_small = _tier_chain(
-            h_tiers,
-            cnt_s_gate,
-            lambda cap: _child_hist_branch(
-                hist_fn, order, bins_T, grad, hess, bag_mask,
-                begin_s, cnt_s, cap,
-            ),
-        )
+        if opt_fused:
+            # record mode: the child's rows are a CONTIGUOUS slice of
+            # the leaf-sorted record — unpack (vector shifts) + kernel,
+            # no indexed access at all
+            def _hist_rec(cap):
+                win = jax.lax.dynamic_slice(
+                    order, (0, begin_s), (Wrec, cap))
+                bins_w, g_w, h_w, m_w = unpack_window(
+                    win, F, k_pack, bin_dt)
+                m_w = m_w * (
+                    jnp.arange(cap, dtype=jnp.int32) < cnt_s
+                ).astype(m_w.dtype)
+                return hist_fn(bins_w, g_w, h_w, m_w)
+
+            h_small = _tier_chain(h_tiers, cnt_s_gate, _hist_rec)
+        else:
+            h_small = _tier_chain(
+                h_tiers,
+                cnt_s_gate,
+                lambda cap: _child_hist_branch(
+                    hist_fn, order, bins_T, grad, hess, bag_mask,
+                    begin_s, cnt_s, cap,
+                ),
+            )
         if pooled:
             # ---- HistogramPool residency (feature_histogram.hpp:337-481):
             # the parent's histogram may have been LRU-evicted since the
@@ -655,48 +717,71 @@ def grow_tree(
             ).astype(jnp.int32)
             h_prev_new = state.hists[s2]
         else:
-            h_parent = state.hists[best_leaf]
-            h_prev_new = state.hists[new_leaf]
-        h_large = h_parent - h_small
-        h_left = jnp.where(small_is_left, h_small, h_large)
-        h_right = jnp.where(small_is_left, h_large, h_small)
-
-        # ---- child best splits (FindBestThresholds on the two new
-        # leaves) — computed BEFORE the buffer update so that every read
-        # of state.hists is finished by then (see barrier below)
+            h_parent = None if opt_fused else state.hists[best_leaf]
+            h_prev_new = None if opt_fused else state.hists[new_leaf]
         depth_child = t.leaf_depth[best_leaf] + 1
-        best_l_new, best_r_new = best2_for(
-            h_left, h_right, lsg, lsh, lc, rsg, rsh, rc, depth_child
-        )
+        if opt_fused:
+            # ---- ONE launch: subtract + child routing + both searches
+            # + in-place buffer row updates (ops/pallas_search.py
+            # _fused_kernel).  No [F, B]-sized intermediate exists as an
+            # XLA value, so there is nothing to relayout and no barrier
+            # is needed — the aliased custom-call IS the buffer update.
+            from ..ops.pallas_search import search2_update_pallas
 
-        # ---- in-place buffer update.  Everything derived from reads of
-        # state.hists (the stacked new rows and the child searches) goes
-        # through ONE optimization_barrier together with the buffer
-        # itself: after the barrier the buffer has no other live readers,
-        # so XLA's copy insertion lets the two-row scatter update it in
-        # place.  (Without this, the compiled while body copied the full
-        # [L, F, B, 3] buffer twice per split — measured in the HLO.)
-        if pooled:
-            # preserve the slots' old contents when the step no-ops
-            new_rows = jnp.stack(
-                [
-                    jnp.where(do_split, h_left, state.hists[s1]),
-                    jnp.where(do_split, h_right, h_prev_new),
-                ]
+            can = (params.max_depth <= 0) | (depth_child < params.max_depth)
+            hists, best_l_new, best_r_new = search2_update_pallas(
+                state.hists, h_small, best_leaf, new_leaf,
+                do_split, small_is_left,
+                lsg, lsh, lc, rsg, rsh, rc, can,
+                feature_mask, num_bins_per_feature, is_categorical,
+                params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
+                params.lambda_l1, params.lambda_l2,
+                params.min_gain_to_split,
+                interpret=_interp,
             )
-            rows_idx = jnp.stack([s1, s2])
         else:
-            new_rows = jnp.stack(
-                [
-                    jnp.where(do_split, h_left, h_parent),
-                    jnp.where(do_split, h_right, h_prev_new),
-                ]
+            h_large = h_parent - h_small
+            h_left = jnp.where(small_is_left, h_small, h_large)
+            h_right = jnp.where(small_is_left, h_large, h_small)
+
+            # ---- child best splits (FindBestThresholds on the two new
+            # leaves) — computed BEFORE the buffer update so that every
+            # read of state.hists is finished by then (see barrier below)
+            best_l_new, best_r_new = best2_for(
+                h_left, h_right, lsg, lsh, lc, rsg, rsh, rc, depth_child
             )
-            rows_idx = jnp.stack([best_leaf, new_leaf])
-        new_rows, best_l_new, best_r_new, hists_in = jax.lax.optimization_barrier(
-            (new_rows, best_l_new, best_r_new, state.hists)
-        )
-        hists = hists_in.at[rows_idx].set(new_rows, unique_indices=True)
+
+            # ---- in-place buffer update.  Everything derived from reads
+            # of state.hists (the stacked new rows and the child
+            # searches) goes through ONE optimization_barrier together
+            # with the buffer itself: after the barrier the buffer has no
+            # other live readers, so XLA's copy insertion lets the
+            # two-row scatter update it in place.  (Without this, the
+            # compiled while body copied the full [L, F, B, 3] buffer
+            # twice per split — measured in the HLO.)
+            if pooled:
+                # preserve the slots' old contents when the step no-ops
+                new_rows = jnp.stack(
+                    [
+                        jnp.where(do_split, h_left, state.hists[s1]),
+                        jnp.where(do_split, h_right, h_prev_new),
+                    ]
+                )
+                rows_idx = jnp.stack([s1, s2])
+            else:
+                new_rows = jnp.stack(
+                    [
+                        jnp.where(do_split, h_left, h_parent),
+                        jnp.where(do_split, h_right, h_prev_new),
+                    ]
+                )
+                rows_idx = jnp.stack([best_leaf, new_leaf])
+            new_rows, best_l_new, best_r_new, hists_in = (
+                jax.lax.optimization_barrier(
+                    (new_rows, best_l_new, best_r_new, state.hists)
+                )
+            )
+            hists = hists_in.at[rows_idx].set(new_rows, unique_indices=True)
 
         if pooled:
             # residency bookkeeping, all masked on do_split: evicted
@@ -815,7 +900,10 @@ def grow_tree(
     leaf_of_pos = perm[
         jnp.searchsorted(sb, jnp.arange(n, dtype=jnp.int32), side="right") - 1
     ]
-    rows = jnp.minimum(state.order[:n], n - 1)
+    rows = jnp.minimum(
+        state.order[_row_id_row, :n] if opt_fused else state.order[:n],
+        n - 1,
+    )
     leaf_id = (
         jnp.zeros(n, jnp.int32).at[rows].set(leaf_of_pos, unique_indices=True)
     )

@@ -16,6 +16,7 @@ Model save/load uses the reference's text format byte-for-byte
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -71,6 +72,10 @@ class GBDT:
         self.valid_metrics: List[List[Metric]] = []
         self.best_iteration = -1
         self._bag_rng = np.random.RandomState(config.bagging_seed)
+        # lagged stop check (see train_one_iter); 0 = eager reference
+        # semantics
+        self._stop_lag = int(os.environ.get("LGBM_TPU_STOP_LAG", "0"))
+        self._pending_stop: List[jax.Array] = []
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
         # reference-parity double accumulation for histograms
         # (include/LightGBM/bin.h:21-22); see Config.hist_dtype.  f64 is
@@ -305,8 +310,6 @@ class GBDT:
         leaf-wise opt path: the split step then never leaves the
         histogram kernel's native layout (grow_tree ``opt`` mode).
         v1-variant TPU only; LGBM_TPU_OPT_HISTS=0 disables."""
-        import os
-
         from ..ops.pallas_histogram import _kernel_variant
 
         if (
@@ -406,6 +409,23 @@ class GBDT:
         """One boosting iteration (gbdt.cpp:217-252).  Returns True when no
         tree could be grown (training should stop)."""
         K = self.num_class
+        # lagged stop check, consume side: BEFORE growing anything this
+        # iteration, materialize parked num_leaves values that are now
+        # ``lag`` iterations old (computed long ago — the int() does not
+        # stall the pipeline).  On terminal detection, roll back every
+        # iteration AFTER the terminal stump — the popped entries map
+        # one-to-one onto the trees grown after it and nothing from the
+        # current call has run yet — leaving the model IDENTICAL to the
+        # eager check's (gbdt.cpp:217-252 stops right at the stump).
+        while self._pending_stop and len(self._pending_stop) >= max(
+            self._stop_lag, 1
+        ):
+            old = self._pending_stop.pop(0)
+            if int(old) <= 1:
+                for _ in range(len(self._pending_stop)):
+                    self.rollback_one_iter()
+                self._pending_stop.clear()
+                return True
         if grad is None or hess is None:
             scores = self._scores if K > 1 else self._scores[0]
             grad, hess = self.objective.get_gradients(scores)
@@ -444,7 +464,25 @@ class GBDT:
                     self._learner_params,
                 )
             tree = tree.shrink(jnp.float32(self.learning_rate))
-            if int(tree.num_leaves) > 1:
+            if self._stop_lag <= 0 or K != 1:
+                if int(tree.num_leaves) > 1:
+                    could_split_any = True
+            else:
+                # lagged stop check (LGBM_TPU_STOP_LAG): int(num_leaves)
+                # every iteration blocks the host on the WHOLE tree
+                # computation, draining the dispatch pipeline and
+                # exposing the axon-tunnel RTT (~0.3 s/tree measured at
+                # 1M rows).  Park the device scalar and start its host
+                # copy; the NEXT call materializes values that are
+                # ``lag`` iterations old (see the check at the top of
+                # this method) and rolls back to the exact eager-mode
+                # state on terminal detection.
+                nl = tree.num_leaves
+                try:
+                    nl.copy_to_host_async()
+                except Exception:
+                    pass
+                self._pending_stop.append(nl)
                 could_split_any = True
             self._scores = self._scores.at[k].add(tree.leaf_value[leaf_id])
             for vi in range(len(self.valid_sets)):
@@ -464,6 +502,11 @@ class GBDT:
             return
         K = self.num_class
         last = self.models[-K:]
+        # any rollback invalidates the parked lagged-stop values: their
+        # indices no longer line up with self.models (the detection path
+        # clears this anyway; external callers get a fresh start —
+        # a still-terminal state is simply re-detected a lag later)
+        self._pending_stop.clear()
         for k, tree in enumerate(last):
             # negative shrinkage = subtraction
             delta = predict_binned(tree, self._bins_T.T)

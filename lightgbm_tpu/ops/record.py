@@ -1,0 +1,261 @@
+"""Leaf-sorted packed training record: the TPU-native DataPartition.
+
+Round-3 on-chip profiling (tools/profile_split.py, BASELINE.md) showed
+the leaf-wise split loop bound by per-index gather/scatter work on
+[n]-sized arrays (~30 ns/element): the partition's feature-row gather
+and order scatter plus the smaller-child bins/grad/hess takes total
+~42M indexed elements per 1M-row 255-leaf tree — almost the whole
+measured s/tree — while contiguous streams run ~40x faster.  The
+reference's DataPartition (data_partition.hpp:91-139) leans on CPU
+caches to make indices()-indirected histogram reads cheap; the TPU
+analog keeps the DATA ITSELF physically leaf-ordered so every per-split
+access is a contiguous slice.
+
+Storage: one i32 record matrix [W, n_pad] whose word-rows are
+
+    rows 0..Wb-1 : binned features, packed k per word (k=4 for u8
+                   bins, k=2 for u16; little-endian within the word)
+    row  Wb      : gradient  (f32 bitcast)
+    row  Wb+1    : hessian   (f32 bitcast)
+    row  Wb+2    : bagging mask (f32 bitcast)
+    row  Wb+3    : original row id (int32; n past the valid prefix)
+
+Split-step primitives:
+
+ *  ``extract_feature`` — split-feature bin values of a leaf's
+    contiguous range: dynamic word-row + contiguous slice + shift.
+ *  ``partition_window`` — stable partition of a leaf's range by the
+    split decision.  Per-tile stable compaction runs in a Pallas
+    kernel: destination positions via strict-triangular MXU dots (no
+    cumsum lowering), a one-hot routing matrix applied to the four i32
+    byte planes (bytes and 0/1 flags are exact in bf16, f32
+    accumulation — the dots are EXACT at default MXU precision), and
+    in-order sliced async DMA placing each tile's left/right runs at
+    their global offsets — later tiles overwrite earlier garbage tails
+    because TPU grids execute sequentially.  Zero per-element
+    descriptors anywhere.
+ *  ``unpack_window`` — a child's contiguous [W, cap] slice back to
+    (bins, grad, hess, mask) for the histogram kernels: vectorized
+    shifts, no indexed access.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 512
+
+
+def round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def bins_per_word(bin_dtype) -> int:
+    return 4 if jnp.dtype(bin_dtype).itemsize == 1 else 2
+
+
+def num_words(F: int, k: int) -> int:
+    return -(-F // k)
+
+
+def rec_height(F: int, k: int) -> int:
+    """Record row count: packed words + 4 stat rows, padded to a
+    sublane-tile multiple of 8 — Mosaic DMA slices must be 8-aligned in
+    the sublane dimension, so the pad rows ride along for free instead
+    of a per-split pad/unpad pass."""
+    return round_up(num_words(F, k) + 4, 8)
+
+
+def pack_bins(bins_T: jax.Array, n_pad: int) -> jax.Array:
+    """[F, n] u8/u16 -> [Wb, n_pad] i32, k features per word."""
+    F, n = bins_T.shape
+    k = bins_per_word(bins_T.dtype)
+    shift = 32 // k
+    Wb = num_words(F, k)
+    x = bins_T.astype(jnp.int32)
+    if n_pad > n:
+        x = jnp.pad(x, ((0, 0), (0, n_pad - n)))
+    if F % k:
+        x = jnp.pad(x, ((0, Wb * k - F), (0, 0)))
+    x = x.reshape(Wb, k, n_pad)
+    out = x[:, 0, :]
+    for j in range(1, k):
+        out = out | (x[:, j, :] << (shift * j))
+    return out
+
+
+def build_record(
+    bins_T: jax.Array,  # [F, n] u8/u16
+    grad: jax.Array,  # [n] f32
+    hess: jax.Array,  # [n] f32
+    bag_mask: jax.Array,  # [n]
+    n_pad: int,
+) -> jax.Array:
+    """Assemble the per-tree record in identity order: one contiguous
+    O(n*W) pass."""
+    n = grad.shape[0]
+
+    def stat_row(v):
+        v = v.astype(jnp.float32)
+        if n_pad > n:
+            v = jnp.pad(v, (0, n_pad - n))
+        return jax.lax.bitcast_convert_type(v, jnp.int32)[None]
+
+    F = bins_T.shape[0]
+    k = bins_per_word(bins_T.dtype)
+    pad_rows = rec_height(F, k) - num_words(F, k) - 4
+    return jnp.concatenate([
+        pack_bins(bins_T, n_pad),
+        stat_row(grad),
+        stat_row(hess),
+        stat_row(bag_mask),
+        jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, n_pad - n),
+                constant_values=n)[None],
+        jnp.zeros((pad_rows, n_pad), jnp.int32),
+    ])
+
+
+def extract_feature(
+    rec: jax.Array, f: jax.Array, begin: jax.Array, cap: int, k: int
+) -> jax.Array:
+    """Split-feature bin values of window [begin, begin+cap): dynamic
+    word-row index + contiguous slice + shift.  ``f`` may be -1 on a
+    no-op step — clamped; the result is masked upstream."""
+    shift = 32 // k
+    f = jnp.maximum(f, 0)
+    word = jax.lax.dynamic_index_in_dim(rec, f // k, axis=0, keepdims=False)
+    win = jax.lax.dynamic_slice(word, (begin,), (cap,))
+    return jax.lax.shift_right_logical(win, (f % k) * shift) & (
+        (1 << shift) - 1)
+
+
+def unpack_window(win: jax.Array, F: int, k: int, bin_dtype):
+    """[W, cap] record slice -> (bins [F, cap], grad, hess, mask)."""
+    Wb = num_words(F, k)
+    shift = 32 // k
+    words = win[:Wb]
+    parts = [((words >> (shift * j)) & ((1 << shift) - 1)) for j in range(k)]
+    bins = jnp.stack(parts, axis=1).reshape(Wb * k, -1)[:F].astype(bin_dtype)
+    g = jax.lax.bitcast_convert_type(win[Wb], jnp.float32)
+    h = jax.lax.bitcast_convert_type(win[Wb + 1], jnp.float32)
+    m = jax.lax.bitcast_convert_type(win[Wb + 2], jnp.float32)
+    return bins, g, h, m
+
+
+def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
+    """One grid step = one [W, T] tile: MXU one-hot stable compaction.
+
+    win_ref  [W, T] i32    : this tile of the record window
+    gcol_ref [T, 1] i32    : go flags (1 = left, valid only)
+    out_ref  [1, W, 2T] i32: lefts compacted to [0, T), everything else
+                             to [T, 2T), original order inside each
+
+    Placement at the (unaligned) global run offsets happens in an XLA
+    dynamic-update-slice scan outside — Mosaic DMA slices must be
+    128-lane aligned, which arbitrary compaction offsets are not.
+    """
+    T = TILE
+    g = gcol_ref[...].astype(jnp.float32)  # [T, 1]
+
+    # strict-lower triangular: Lt[t, b] = 1.0 iff b < t; positions via
+    # MXU dots (inputs 0/1 -> exact at any precision, f32 accumulation)
+    t_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    b_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    lt = (b_i < t_i).astype(jnp.float32)
+    lpos = jax.lax.dot_general(
+        lt, g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [T, 1] lefts before t
+    rpos = jax.lax.dot_general(
+        lt, 1.0 - g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    pos = jnp.where(g > 0, lpos, rpos + T).astype(jnp.int32)  # [T, 1]
+
+    hot = (pos == jax.lax.broadcasted_iota(jnp.int32, (T, 2 * T), 1)
+           ).astype(jnp.float32)  # [T, 2T] routing matrix
+    tile = win_ref[...]  # [W, T] i32
+    comp = jnp.zeros((W, 2 * T), jnp.int32)
+    for b in range(4):
+        byte = ((tile >> (8 * b)) & 0xFF).astype(jnp.float32)
+        m = jax.lax.dot_general(
+            byte, hot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [W, 2T]
+        comp = comp | (m.astype(jnp.int32) << (8 * b))
+    out_ref[0] = comp
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def partition_window(
+    rec: jax.Array,  # [W, n_pad] i32
+    go: jax.Array,  # [cap] bool: left-going (valid rows only)
+    begin: jax.Array,
+    pcnt: jax.Array,
+    do_split: jax.Array,
+    cap: int,
+    interpret: bool = False,
+):
+    """Stably partition window [begin, begin+cap) of ``rec``: the
+    parent's rows [0, pcnt) become left-rows ++ right-rows (original
+    order within each), positions [pcnt, cap) — other leaves' rows
+    inside the static tier window, or the n_pad tail — are preserved
+    exactly.  Returns (rec', nleft).  DataPartition::Split
+    (data_partition.hpp:91-139) re-designed for the TPU memory system.
+    """
+    W = rec.shape[0]
+    T = TILE
+    assert cap % T == 0, (cap, T)
+    nt = cap // T
+
+    win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = iota < pcnt
+    gov = go & valid
+    nleft = jnp.sum(gov, dtype=jnp.int32)
+
+    kt = gov.reshape(nt, T)
+    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
+    # rights per tile INCLUDE the invalid tail: invalids are a SUFFIX of
+    # the window, so within any tile valid rights precede invalids and
+    # each right-run's valid prefix lands at the right global offset;
+    # the garbage beyond total-valid-rights is cut by the final selects
+    cr = jnp.sum(valid.reshape(nt, T) & ~kt, axis=1, dtype=jnp.int32)
+    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
+    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+
+    comp = pl.pallas_call(
+        functools.partial(_compact_kernel, W=W),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((W, T), lambda i: (0, i)),
+            pl.BlockSpec((T, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, W, 2 * T), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
+        interpret=interpret,
+    )(win, gov.astype(jnp.int32).reshape(cap, 1))
+
+    # in-order placement: sequential DUS writes let each tile's garbage
+    # tail be overwritten by the next tile's run
+    def place(carry, x):
+        lbuf, rbuf = carry
+        c, lo, ro = x
+        lbuf = jax.lax.dynamic_update_slice(lbuf, c[:, :T], (0, lo))
+        rbuf = jax.lax.dynamic_update_slice(rbuf, c[:, T:], (0, ro))
+        return (lbuf, rbuf), None
+
+    buf0 = jnp.zeros((W, cap + T), jnp.int32)
+    (lbuf, rbuf), _ = jax.lax.scan(
+        place, (buf0, buf0), (comp, loff, roff))
+
+    # merge: [0, nleft) from the left runs, [nleft, pcnt) from the right
+    # runs shifted to start at nleft (dynamic roll = two contiguous
+    # slices), everything else keeps its original value
+    rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
+    merged = jnp.where((iota < nleft)[None, :], lbuf[:, :cap], rolled)
+    keep = (valid & do_split)[None, :]
+    out = jnp.where(keep, merged, win)
+    return jax.lax.dynamic_update_slice(rec, out, (0, begin)), nleft

@@ -260,3 +260,58 @@ def test_metric_eval_jax_matches_host():
         host = m.eval(sk.astype(np.float64))
         dev = float(m.eval_jax_jit(jnp.asarray(sk)))
         assert abs(host - dev) < 5e-5, (m.name, host, dev)
+
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("bag", [False, True])
+def test_lagged_stop_check_matches_eager(monkeypatch, bag):
+    """LGBM_TPU_STOP_LAG must terminate with the IDENTICAL model as the
+    eager per-iteration check: extra iterations past the no-split
+    terminal state are rolled back (train_one_iter lag path)."""
+    import os
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(0)
+    # tiny, exhaustible problem: growth hits the no-split state quickly
+    X = rng.randint(0, 3, (60, 2)).astype(np.float64)
+    y = (X[:, 0] > 1).astype(np.float32)
+
+    def train(lag):
+        monkeypatch.setenv("LGBM_TPU_STOP_LAG", str(lag))
+        # the bagging case pins the round-3 review finding: post-terminal
+        # iterations see different bagging samples and can grow REAL
+        # trees — the rollback must still restore the eager model
+        extra = dict(bagging_fraction=0.3, bagging_freq=1,
+                     bagging_seed=2, min_gain_to_split=0.3) if bag else {}
+        cfg = Config(objective="regression", num_leaves=8, max_bin=8,
+                     learning_rate=0.9, min_data_in_leaf=1, metric=[],
+                     **extra)
+        ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+        b = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
+        for _ in range(60):
+            if b.train_one_iter():
+                break
+        return b
+
+    b0 = train(0)
+    b4 = train(4)
+    assert len(b0.models) == len(b4.models)
+    for t0, t4 in zip(b0.models, b4.models):
+        np.testing.assert_array_equal(
+            np.asarray(t0.split_feature), np.asarray(t4.split_feature))
+        np.testing.assert_allclose(
+            np.asarray(t0.leaf_value), np.asarray(t4.leaf_value),
+            rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(b0._scores), np.asarray(b4._scores),
+        rtol=1e-5, atol=1e-6)
