@@ -27,7 +27,8 @@ import time
 import numpy as np
 
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench")
-_TUNED_KEYS = ("LGBM_TPU_TIER_SPACING", "LGBM_TPU_HIST_KERNEL")
+_TUNED_KEYS = ("LGBM_TPU_TIER_SPACING", "LGBM_TPU_HIST_KERNEL",
+               "LGBM_TPU_REC_TILE")
 
 
 def apply_tuned_defaults() -> None:
@@ -321,6 +322,7 @@ def ours_sec_per_tree(X, y, growth: str) -> tuple[float, float]:
                 break
     _ = np.asarray(booster._scores)
     elapsed = time.perf_counter() - t0
+    booster.finish_lagged_stop()
     auc = booster.eval_at(0).get("auc", float("nan"))
     log(f"ours: {done} trees in {elapsed:.1f}s, train AUC={auc:.4f}")
     return elapsed / done, auc

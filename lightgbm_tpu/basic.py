@@ -389,6 +389,11 @@ class Booster:
         self._gbdt.add_valid_dataset(data.construct(), name)
         self.name_valid_sets.append(name)
 
+    def finish_lagged_stop(self) -> None:
+        """Drain the lagged stop check after the last update() call
+        (no-op unless LGBM_TPU_STOP_LAG is set) — see GBDT."""
+        self._gbdt.finish_lagged_stop()
+
     def update(self, train_set: Optional[Dataset] = None, fobj: Optional[Callable] = None) -> bool:
         """One boosting iteration; returns True if no further training is
         possible (basic.py:1431-1501)."""

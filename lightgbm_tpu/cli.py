@@ -252,6 +252,9 @@ def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
             Log.info("Stopped training because there are no more leaves "
                      "that meet the split requirements.")
             break
+    # drain the lagged stop check when the loop ended by iteration count
+    # (no-op unless LGBM_TPU_STOP_LAG is set)
+    booster.finish_lagged_stop()
     return None
 
 

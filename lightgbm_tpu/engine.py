@@ -121,6 +121,9 @@ def train(
             break
         if is_finished:
             break
+    # drain the lagged stop check when the loop ended by round count
+    # (no-op unless LGBM_TPU_STOP_LAG is set)
+    booster.finish_lagged_stop()
     if booster.best_iteration <= 0:
         booster.best_iteration = -1
     return booster

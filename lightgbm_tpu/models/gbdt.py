@@ -32,14 +32,26 @@ from .tree import (
     Tree,
     empty_tree,
     finalize_thresholds,
+    finalize_thresholds_device,
     ensemble_leaves_raw,
     ensemble_sum_binned,
     ensemble_sum_raw,
+    pack_threshold_bounds,
     predict_binned,
     predict_raw,
     stack_trees,
     predict_leaf_raw,
 )
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _post_grow_step(tree, scores, k, leaf_id, rate, bounds_mat, real_feat):
+    """Shrinkage + score update + device-side threshold finalization in
+    one dispatch (gbdt.cpp:229-247's post-train steps)."""
+    tree = tree.shrink(rate)
+    scores = scores.at[k].add(tree.leaf_value[leaf_id])
+    tree = finalize_thresholds_device(tree, bounds_mat, real_feat)
+    return tree, scores
 
 
 class GBDT:
@@ -107,6 +119,8 @@ class GBDT:
         self._learner_params = TreeLearnerParams.from_config(self.config)
         self._real_feat = train_set.real_feature_indices
         self._bin_thresholds = train_set.bin_thresholds_real()
+        self._bounds_mat, self._real_feat_dev = pack_threshold_bounds(
+            self._bin_thresholds, self._real_feat)
         self._grow = self._create_tree_learner()
 
         K = self.num_class
@@ -463,7 +477,6 @@ class GBDT:
                     self._is_cat,
                     self._learner_params,
                 )
-            tree = tree.shrink(jnp.float32(self.learning_rate))
             if self._stop_lag <= 0 or K != 1:
                 if int(tree.num_leaves) > 1:
                     could_split_any = True
@@ -484,16 +497,38 @@ class GBDT:
                     pass
                 self._pending_stop.append(nl)
                 could_split_any = True
-            self._scores = self._scores.at[k].add(tree.leaf_value[leaf_id])
+            # shrink + score apply + threshold finalization as ONE
+            # dispatch (each eager jnp op is its own round trip over the
+            # axon tunnel; the host-side finalize_thresholds even forced
+            # a full device sync per tree)
+            tree, self._scores = _post_grow_step(
+                tree, self._scores, jnp.int32(k),
+                leaf_id, jnp.float32(self.learning_rate),
+                self._bounds_mat, self._real_feat_dev,
+            )
             for vi in range(len(self.valid_sets)):
                 self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
                     predict_binned(tree, self._valid_bins[vi])
                 )
-            tree = finalize_thresholds(tree, self._bin_thresholds, self._real_feat)
             self.models.append(tree)
         self.iter_ += 1
         self._model_version += 1
         return not could_split_any
+
+    def finish_lagged_stop(self) -> None:
+        """Drain the lagged stop check's parked values after the LAST
+        train_one_iter call.  When training ends by iteration count, the
+        parked num_leaves of the final ``lag`` iterations were never
+        materialized; a terminal stump among them means later iterations
+        must be rolled back to restore the eager-mode model.  No-op
+        without LGBM_TPU_STOP_LAG."""
+        while self._pending_stop:
+            old = self._pending_stop.pop(0)
+            if int(old) <= 1:
+                for _ in range(len(self._pending_stop)):
+                    self.rollback_one_iter()
+                self._pending_stop.clear()
+                break
 
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:254-271): subtract the last

@@ -335,17 +335,10 @@ def _fused_kernel(scal_i_ref, scal_f_ref, hrow_ref, hsmall_ref, meta_ref,
         for cc in range(2):
             side = (h_left, h_right)[cc]
             hg, hh, hc = side[:, 0, :], side[:, 1, :], side[:, 2, :]
-
-            def tail_of(x):
-                return jax.lax.dot_general(
-                    x, tri, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST,
-                )
-
             _child_search(
                 cc, hg, hh, hc,
-                tail_of(hg), tail_of(hh) + K_EPSILON, tail_of(hc),
+                _tail_of(hg, tri), _tail_of(hh, tri) + K_EPSILON,
+                _tail_of(hc, tri),
                 scal_f_ref, meta_ref, res_ref, F, B,
             )
 

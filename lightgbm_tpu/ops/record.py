@@ -48,7 +48,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE = 512
+import os as _os
+
+# partition tile width; larger tiles halve the placement-scan step count
+# at quadratically more (cheap) MXU routing work per tile
+TILE = int(_os.environ.get("LGBM_TPU_REC_TILE", "512"))
 
 
 def round_up(x: int, m: int) -> int:
@@ -213,7 +217,9 @@ def partition_window(
     win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
     iota = jnp.arange(cap, dtype=jnp.int32)
     valid = iota < pcnt
-    gov = go & valid
+    # i32 from the start: pred (1-bit) arrays at [cap, 1]-ish shapes
+    # bounce between bit layouts (measured ~80 ms/tree of copies)
+    gov = (go & valid).astype(jnp.int32)
     nleft = jnp.sum(gov, dtype=jnp.int32)
 
     kt = gov.reshape(nt, T)
@@ -222,7 +228,8 @@ def partition_window(
     # the window, so within any tile valid rights precede invalids and
     # each right-run's valid prefix lands at the right global offset;
     # the garbage beyond total-valid-rights is cut by the final selects
-    cr = jnp.sum(valid.reshape(nt, T) & ~kt, axis=1, dtype=jnp.int32)
+    cr = jnp.sum(valid.reshape(nt, T).astype(jnp.int32) - kt,
+                 axis=1, dtype=jnp.int32)
     loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
     roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
 
@@ -236,7 +243,7 @@ def partition_window(
         out_specs=pl.BlockSpec((1, W, 2 * T), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
         interpret=interpret,
-    )(win, gov.astype(jnp.int32).reshape(cap, 1))
+    )(win, gov.reshape(cap, 1))
 
     # in-order placement: sequential DUS writes let each tile's garbage
     # tail be overwritten by the next tile's run

@@ -286,7 +286,7 @@ def test_lagged_stop_check_matches_eager(monkeypatch, bag):
     X = rng.randint(0, 3, (60, 2)).astype(np.float64)
     y = (X[:, 0] > 1).astype(np.float32)
 
-    def train(lag):
+    def train(lag, cap=60):
         monkeypatch.setenv("LGBM_TPU_STOP_LAG", str(lag))
         # the bagging case pins the round-3 review finding: post-terminal
         # iterations see different bagging samples and can grow REAL
@@ -298,9 +298,10 @@ def test_lagged_stop_check_matches_eager(monkeypatch, bag):
                      **extra)
         ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
         b = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
-        for _ in range(60):
+        for _ in range(cap):
             if b.train_one_iter():
                 break
+        b.finish_lagged_stop()
         return b
 
     b0 = train(0)
@@ -315,3 +316,47 @@ def test_lagged_stop_check_matches_eager(monkeypatch, bag):
     np.testing.assert_allclose(
         np.asarray(b0._scores), np.asarray(b4._scores),
         rtol=1e-5, atol=1e-6)
+
+
+def test_lagged_stop_drain_at_iteration_cap(monkeypatch):
+    """When training ends by iteration count with a terminal stump still
+    parked, finish_lagged_stop must roll the extra iterations back (the
+    round-3 review finding: without the drain, post-terminal trees
+    survive in the final model)."""
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 3, (60, 2)).astype(np.float64)
+    y = (X[:, 0] > 1).astype(np.float32)
+
+    def train(lag, cap):
+        monkeypatch.setenv("LGBM_TPU_STOP_LAG", str(lag))
+        cfg = Config(objective="regression", num_leaves=8, max_bin=8,
+                     learning_rate=0.9, min_data_in_leaf=1, metric=[],
+                     bagging_fraction=0.3, bagging_freq=1, bagging_seed=2,
+                     min_gain_to_split=0.3)
+        ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+        b = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
+        stopped_at = None
+        for i in range(cap):
+            if b.train_one_iter():
+                stopped_at = i
+                break
+        b.finish_lagged_stop()
+        return b, stopped_at
+
+    b0, s0 = train(0, cap=100)
+    assert s0 is not None  # the problem IS exhaustible
+    # cap the lagged run so the loop ends BEFORE detection would fire
+    b4, s4 = train(4, cap=s0 + 2)
+    assert len(b0.models[: s0 + 1]) == len(b4.models), (
+        len(b0.models), len(b4.models), s0)
+    for t0, t4 in zip(b0.models, b4.models):
+        np.testing.assert_array_equal(
+            np.asarray(t0.split_feature), np.asarray(t4.split_feature))
