@@ -260,9 +260,12 @@ def partition_window(
 
     # merge: [0, nleft) from the left runs, [nleft, pcnt) from the right
     # runs shifted to start at nleft (dynamic roll = two contiguous
-    # slices), everything else keeps its original value
+    # slices), everything else keeps its original value.  Selects are
+    # ARITHMETIC on i32 masks: [cap, 1]-shaped pred tensors bounce
+    # between bit layouts on this stack (~100 ms/tree of copies)
     rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
-    merged = jnp.where((iota < nleft)[None, :], lbuf[:, :cap], rolled)
-    keep = (valid & do_split)[None, :]
-    out = jnp.where(keep, merged, win)
+    is_left = (iota < nleft).astype(jnp.int32)[None, :]
+    merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
+    keep = (valid.astype(jnp.int32) * do_split.astype(jnp.int32))[None, :]
+    out = merged * keep + win * (1 - keep)
     return jax.lax.dynamic_update_slice(rec, out, (0, begin)), nleft
