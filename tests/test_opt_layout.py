@@ -97,3 +97,41 @@ def test_opt_with_hist_pool():
     np.testing.assert_array_equal(
         np.asarray(t0.split_feature), np.asarray(t1.split_feature))
     np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+
+
+def test_opt_u16_bins_and_feature_mask():
+    """max_bin > 256 stores u16 bins (2 per record word, k=2): the
+    packed-record path must match the canonical path there too, and
+    under feature_fraction masking."""
+    rng = np.random.RandomState(5)
+    n, F, num_bins = 3000, 5, 300  # > 256 -> uint16 bins
+    bins = rng.randint(0, num_bins, (n, F))
+    grad = rng.randint(-8, 9, n).astype(np.float32)
+    hess = rng.randint(1, 5, n).astype(np.float32)
+    fmask = np.array([True, False, True, True, False])
+
+    def grow(raw):
+        return grow_tree(
+            jnp.asarray(bins.T.astype(np.uint16)),
+            jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(n, jnp.float32),
+            jnp.asarray(fmask),
+            jnp.full(F, num_bins, jnp.int32),
+            jnp.zeros(F, bool),
+            params(min_data=3),
+            num_bins=num_bins,
+            max_leaves=16,
+            hist_fn_raw=_raw_hist_fn(num_bins) if raw else None,
+        )
+
+    t0, l0 = grow(False)
+    t1, l1 = grow(True)
+    assert int(t0.num_leaves) == int(t1.num_leaves) > 4
+    np.testing.assert_array_equal(
+        np.asarray(t0.split_feature), np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(
+        np.asarray(t0.threshold_bin), np.asarray(t1.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    # masked features never appear as split features
+    used = np.asarray(t1.split_feature)
+    assert not np.isin(used[used >= 0], [1, 4]).any()
