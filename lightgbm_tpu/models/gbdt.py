@@ -334,7 +334,10 @@ class GBDT:
         ):
             from ..ops.pallas_histogram import make_single_hist_fn_raw
 
-            return make_single_hist_fn_raw(self._num_bins)
+            return make_single_hist_fn_raw(
+                self._num_bins,
+                chunk=int(os.environ.get("LGBM_TPU_HIST_CHUNK", "512")),
+            )
         return None
 
     def _depthwise_hist_fn(self):
