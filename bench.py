@@ -57,6 +57,9 @@ def apply_tuned_defaults() -> None:
 ROWS = int(float(os.environ.get("BENCH_ROWS", 1_000_000)))
 TREES = int(os.environ.get("BENCH_TREES", 10))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 300))
+# held-out rows for the out-of-sample AUC column (VERDICT r3 item 5:
+# "identical AUC" must be evidenced out-of-sample, not just on train)
+VROWS = int(float(os.environ.get("BENCH_VALID", max(ROWS // 5, 1))))
 N_FEAT, NUM_BINS, NUM_LEAVES = 28, 255, 255
 LEARNING_RATE, MIN_DATA = 0.1, 100
 
@@ -65,15 +68,34 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_data(n: int, seed: int = 7):
-    """HIGGS-like: 28 correlated features, nonlinear decision boundary."""
+def make_data(n: int, seed: int = 7, n_valid: int = 0):
+    """HIGGS-like: 28 correlated features, nonlinear decision boundary.
+
+    With ``n_valid`` > 0 also returns a held-out set drawn from the SAME
+    decision boundary (w1/w2), appended to the return tuple.  The train
+    rows are drawn first so they stay bit-identical to the n_valid=0
+    call — cached reference-CLI baselines keyed on the train data remain
+    valid.
+    """
     rng = np.random.RandomState(seed)
-    X = rng.randn(n, N_FEAT).astype(np.float32)
+
+    def draw(m):
+        X = rng.randn(m, N_FEAT).astype(np.float32)
+        return X
+
+    def label(X, w1, w2):
+        z = X @ w1 + 0.5 * (X**2 - 1.0) @ w2 + 0.8 * X[:, 0] * X[:, 1]
+        z = (z - z.mean()) / z.std()
+        return (z + 0.5 * rng.randn(len(X)) > 0).astype(np.float32)
+
+    X = draw(n)
     w1, w2 = rng.randn(N_FEAT), rng.randn(N_FEAT)
-    z = X @ w1 + 0.5 * (X**2 - 1.0) @ w2 + 0.8 * X[:, 0] * X[:, 1]
-    z = (z - z.mean()) / z.std()
-    y = (z + 0.5 * rng.randn(n) > 0).astype(np.float32)
-    return X, y
+    y = label(X, w1, w2)
+    if not n_valid:
+        return X, y
+    Xv = draw(n_valid)
+    yv = label(Xv, w1, w2)
+    return X, y, Xv, yv
 
 
 # --------------------------------------------------------------- reference
@@ -101,8 +123,36 @@ def build_reference_cli() -> str | None:
         return None
 
 
-def reference_sec_per_tree(X, y, key: str):
-    """Returns (sec_per_tree, ref_train_auc) or (None, None)."""
+def run_reference_cli(exe: str, data_path: str, model_path: str,
+                      trees: int, timeout_s: float = 3600):
+    """Run the reference CLI at the bench config and isolate training
+    time from data loading via its own per-iteration log
+    (application.cpp:228-235).  Returns (sec_per_tree, total_s, proc) or
+    (None, total_s, proc) on failure."""
+    import subprocess
+
+    conf = [
+        "task=train", f"data={data_path}", "objective=binary",
+        f"num_trees={trees}", f"num_leaves={NUM_LEAVES}",
+        f"max_bin={NUM_BINS}", f"learning_rate={LEARNING_RATE}",
+        f"min_data_in_leaf={MIN_DATA}", "verbosity=1",
+        f"output_model={model_path}", "is_save_binary_file=false",
+    ]
+    t0 = time.perf_counter()
+    proc = subprocess.run([exe] + conf, capture_output=True, text=True,
+                          timeout=timeout_s)
+    total = time.perf_counter() - t0
+    if proc.returncode != 0:
+        return None, total, proc
+    sec = None
+    for line in proc.stdout.splitlines():
+        if "seconds elapsed, finished iteration" in line:
+            sec = float(line.split("]")[-1].strip().split()[0])
+    return ((sec / trees) if sec else total / trees), total, proc
+
+
+def reference_sec_per_tree(X, y, key: str, Xv=None, yv=None):
+    """Returns (sec_per_tree, ref_train_auc, ref_valid_auc)."""
     os.makedirs(CACHE_DIR, exist_ok=True)
     cache = os.path.join(CACHE_DIR, f"baseline_{key}.json")
     model_path = f"/tmp/bench_ref_model_{key}.txt"  # keyed: a stale or
@@ -110,54 +160,67 @@ def reference_sec_per_tree(X, y, key: str):
     if os.path.exists(cache):
         with open(cache) as fh:
             data = json.load(fh)
+        dirty = False
         if data.get("ref_auc") is None and os.path.exists(model_path):
             try:  # cache predates the AUC field — backfill it
                 data["ref_auc"] = _model_train_auc(model_path, X, y)
-                with open(cache, "w") as fh:
-                    json.dump(data, fh)
+                dirty = True
             except Exception as e:
                 log(f"reference AUC backfill failed: {e}")
-        return data["sec_per_tree"], data.get("ref_auc")
+        if (Xv is not None and os.path.exists(model_path)
+                and data.get("ref_valid_auc_rows") != len(Xv)):
+            try:  # valid AUC keyed by held-out size (backfill/refresh)
+                data["ref_valid_auc"] = _model_train_auc(model_path, Xv, yv)
+                data["ref_valid_auc_rows"] = len(Xv)
+                dirty = True
+            except Exception as e:
+                log(f"reference valid-AUC backfill failed: {e}")
+        if dirty:
+            with open(cache, "w") as fh:
+                json.dump(data, fh)
+        # a valid AUC computed for a DIFFERENT held-out size must never
+        # feed this run's parity columns (possible when the model file is
+        # gone so the backfill above couldn't refresh it)
+        v_auc = data.get("ref_valid_auc")
+        if Xv is None or data.get("ref_valid_auc_rows") != len(Xv):
+            v_auc = None
+        return data["sec_per_tree"], data.get("ref_auc"), v_auc
     exe = build_reference_cli()
     if exe is None:
-        return None, None
+        return None, None, None
     data_path = f"/tmp/bench_{key}.csv"
     if not os.path.exists(data_path):
         log("writing reference CSV ...")
         arr = np.column_stack([y, X])
         np.savetxt(data_path, arr, fmt="%.6g", delimiter=",")
-    conf = [
-        "task=train", f"data={data_path}", "objective=binary",
-        f"num_trees={TREES}", f"num_leaves={NUM_LEAVES}",
-        f"max_bin={NUM_BINS}", f"learning_rate={LEARNING_RATE}",
-        f"min_data_in_leaf={MIN_DATA}", "verbosity=1",
-        f"output_model={model_path}", "is_save_binary_file=false",
-    ]
     log("running reference CLI baseline ...")
-    t0 = time.perf_counter()
-    proc = subprocess.run([exe] + conf, capture_output=True, text=True,
-                          timeout=3600)
-    total = time.perf_counter() - t0
-    if proc.returncode != 0:
+    sec_per_tree, total, proc = run_reference_cli(
+        exe, data_path, model_path, TREES)
+    if sec_per_tree is None:
         log(f"reference run failed: {proc.stdout[-500:]} {proc.stderr[-500:]}")
-        return None, None
-    # isolate training time from data loading via the CLI's own iter log
-    sec = None
-    for line in proc.stdout.splitlines():
-        if "seconds elapsed, finished iteration" in line:
-            sec = float(line.split("]")[-1].strip().split()[0])
-    sec_per_tree = (sec / TREES) if sec else total / TREES
-    ref_auc = None
+        return None, None, None
+    ref_auc = ref_valid_auc = None
     try:  # train AUC of the reference model, for the identical-AUC claim
         ref_auc = _model_train_auc(model_path, X, y)
     except Exception as e:
         log(f"reference AUC computation failed: {e}")
+    if Xv is not None:
+        try:
+            ref_valid_auc = _model_train_auc(model_path, Xv, yv)
+        except Exception as e:
+            log(f"reference valid-AUC computation failed: {e}")
     with open(cache, "w") as fh:
+        # ref_valid_auc_rows is only stamped on SUCCESS: a transient
+        # failure must leave the backfill (keyed on rows mismatch) armed
         json.dump({"sec_per_tree": sec_per_tree, "total_s": total,
-                   "trees": TREES, "rows": ROWS, "ref_auc": ref_auc}, fh)
+                   "trees": TREES, "rows": ROWS, "ref_auc": ref_auc,
+                   "ref_valid_auc": ref_valid_auc,
+                   "ref_valid_auc_rows":
+                       None if ref_valid_auc is None else len(Xv)},
+                  fh)
     log(f"reference baseline: {sec_per_tree:.3f}s/tree (total {total:.1f}s, "
-        f"train AUC={ref_auc})")
-    return sec_per_tree, ref_auc
+        f"train AUC={ref_auc}, valid AUC={ref_valid_auc})")
+    return sec_per_tree, ref_auc, ref_valid_auc
 
 
 def _model_train_auc(model_path: str, X, y) -> float:
@@ -245,7 +308,7 @@ def _init_backend() -> str:
 _DATASET_CACHE: dict = {}
 
 
-def ours_sec_per_tree(X, y, growth: str) -> tuple[float, float]:
+def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
     """Train TREES trees; caller has already resolved the backend via
     _init_backend() (so failures here happen ON the resolved platform)."""
 
@@ -324,8 +387,18 @@ def ours_sec_per_tree(X, y, growth: str) -> tuple[float, float]:
     elapsed = time.perf_counter() - t0
     booster.finish_lagged_stop()
     auc = booster.eval_at(0).get("auc", float("nan"))
-    log(f"ours: {done} trees in {elapsed:.1f}s, train AUC={auc:.4f}")
-    return elapsed / done, auc
+    valid_auc = float("nan")
+    if Xv is not None:
+        # attached AFTER the timed loop: add_valid_dataset replays the
+        # trained model onto the valid scores, so the out-of-sample AUC
+        # column costs the timed section nothing
+        ds = _DATASET_CACHE["ds"]
+        va = ds.align_with(Xv, Metadata(label=yv.astype(np.float32)))
+        booster.add_valid_dataset(va, "bench_valid")
+        valid_auc = booster.eval_at(1).get("auc", float("nan"))
+    log(f"ours: {done} trees in {elapsed:.1f}s, train AUC={auc:.4f}, "
+        f"valid AUC={valid_auc:.4f}")
+    return elapsed / done, auc, valid_auc
 
 
 def main() -> None:
@@ -352,16 +425,21 @@ def main() -> None:
             raise RuntimeError(
                 f"BENCH_REQUIRE_TPU is set but the backend is {platform!r}"
             )
-        X, y = make_data(ROWS)
+        if VROWS > 0:
+            X, y, Xv, yv = make_data(ROWS, n_valid=VROWS)
+        else:  # BENCH_VALID=0 disables the out-of-sample column
+            (X, y), Xv, yv = make_data(ROWS), None, None
         growth = os.environ.get("BENCH_GROWTH", "leafwise")
-        ours, auc = ours_sec_per_tree(X, y, growth)
+        ours, auc, valid_auc = ours_sec_per_tree(X, y, growth, Xv, yv)
         out["value"] = round(ours, 4)
         out["growth"] = growth
         knobs = {k: os.environ[k] for k in _TUNED_KEYS if k in os.environ}
         if knobs:
             out["knobs"] = knobs
         out["train_auc"] = round(float(auc), 4)
-        ref, ref_auc = reference_sec_per_tree(X, y, key)
+        if Xv is not None:
+            out["valid_auc"] = round(float(valid_auc), 4)
+        ref, ref_auc, ref_valid_auc = reference_sec_per_tree(X, y, key, Xv, yv)
         if ref and ours > 0:
             out["vs_baseline"] = round(ref / ours, 3)
         if ref_auc is not None:
@@ -374,9 +452,15 @@ def main() -> None:
             # NaN must propagate (a missing AUC is a failure, not a pass)
             gap = float("nan") if delta != delta else max(0.0, -delta)
             out["auc_gap"] = round(gap, 4)
+        if ref_valid_auc is not None and Xv is not None:
+            out["ref_valid_auc"] = round(float(ref_valid_auc), 4)
+            vdelta = out["valid_auc"] - float(ref_valid_auc)
+            out["valid_auc_delta"] = round(vdelta, 4)
+            vgap = float("nan") if vdelta != vdelta else max(0.0, -vdelta)
+            out["valid_auc_gap"] = round(vgap, 4)
         if os.environ.get("BENCH_SECONDARY", "0") != "0":
             # optional secondary row: the level-synchronous approximation
-            sec, sec_auc = ours_sec_per_tree(X, y, "depthwise")
+            sec, sec_auc, _ = ours_sec_per_tree(X, y, "depthwise")
             out["secondary"] = {
                 "growth": "depthwise", "value": round(sec, 4),
                 "train_auc": round(float(sec_auc), 4),

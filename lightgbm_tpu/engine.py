@@ -124,6 +124,11 @@ def train(
     # drain the lagged stop check when the loop ended by round count
     # (no-op unless LGBM_TPU_STOP_LAG is set)
     booster.finish_lagged_stop()
+    # lagged-stop rollback may have popped trees the early-stopping
+    # callback already scored; best_iteration must never point past the
+    # surviving model (ADVICE r3: gbdt.py rollback interaction)
+    if booster.best_iteration > booster.current_iteration:
+        booster.best_iteration = booster.current_iteration
     if booster.best_iteration <= 0:
         booster.best_iteration = -1
     return booster

@@ -38,10 +38,16 @@ parallel == serial trees (split_info.hpp:98-103 semantics).
 from __future__ import annotations
 
 import functools
+import os as _os
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# Read ONCE at import (like ops.record.TILE): grow_tree reads this at
+# trace time but the jit cache keys only on static args, so a mid-process
+# env flip would silently not apply to already-traced shapes (ADVICE r3).
+_KERN_ENV = _os.environ.get("LGBM_TPU_SEARCH_KERNEL", "pallas") != "jnp"
 
 from ..models.tree import Tree, empty_tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
@@ -320,9 +326,7 @@ def grow_tree(
     # the round-3 profile showed radiating from the [F, B, 3] transpose
     # (~0.5 ms/split).  Only the default serial hook set qualifies;
     # parallel learners and the hybrid resume keep the canonical layout.
-    import os as _os
-
-    _kern_env = _os.environ.get("LGBM_TPU_SEARCH_KERNEL", "pallas") != "jnp"
+    _kern_env = _KERN_ENV
     _interp = jax.default_backend() != "tpu"
     opt = (
         hist_fn_raw is not None

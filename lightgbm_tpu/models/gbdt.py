@@ -664,8 +664,22 @@ class GBDT:
         ]
         return {names[i]: int(imp[i]) for i in range(len(imp)) if imp[i] > 0}
 
+    def _lagged_terminal_drop(self) -> int:
+        """Number of TRAILING trees a finish_lagged_stop() drain would
+        roll back, computed WITHOUT mutating state: the parked values are
+        synced (a save reads host arrays anyway) but nothing is popped —
+        a mid-training checkpoint must not yank trees out from under the
+        running train loop (ADVICE r3 / review r4)."""
+        for i, old in enumerate(self._pending_stop):
+            if int(old) <= 1:
+                return (len(self._pending_stop) - 1 - i) * self.num_class
+        return 0
+
     def save_model_to_string(self, num_iteration: int = -1) -> str:
-        """Reference text format (gbdt.cpp:479-521)."""
+        """Reference text format (gbdt.cpp:479-521).  With a lagged stop
+        check (LGBM_TPU_STOP_LAG) active, trees a future drain would roll
+        back are excluded from the STRING only — in-memory state is not
+        touched, so checkpoint-every-iteration callbacks stay safe."""
         out = [self.name]
         out.append(f"num_class={self.num_class}")
         out.append(f"label_index={self.label_idx}")
@@ -678,7 +692,7 @@ class GBDT:
         ]
         out.append("feature_names=" + " ".join(names))
         out.append("")
-        num_used = len(self.models)
+        num_used = len(self.models) - self._lagged_terminal_drop()
         if num_iteration > 0:
             num_used = min(num_iteration * self.num_class, num_used)
         for i in range(num_used):
@@ -820,7 +834,8 @@ class GBDT:
         names = self.feature_names or [
             f"Column_{i}" for i in range(self.max_feature_idx + 1)
         ]
-        num_used = len(self.models)
+        # same non-mutating guarantee as save_model_to_string
+        num_used = len(self.models) - self._lagged_terminal_drop()
         if num_iteration > 0:
             num_used = min(num_iteration * self.num_class, num_used)
         return {

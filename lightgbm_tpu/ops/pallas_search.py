@@ -230,6 +230,14 @@ def search2_pallas(
     scalar SplitResults matching ops/split.find_best_split bit-for-bit
     up to the suffix-sum accumulation order (MXU triangular dot vs
     sequential cumsum — identical under exact arithmetic)."""
+    if h_left.dtype != jnp.float32 or h_right.dtype != jnp.float32:
+        # a silent astype here would hide precision loss from a future
+        # float64 hist_dtype caller; the f64 parity mode must stay on
+        # the jnp search path (serial.py routes on hl.dtype)
+        raise TypeError(
+            f"search2_pallas requires float32 histograms, got "
+            f"{h_left.dtype}/{h_right.dtype}"
+        )
     F, B, _ = h_left.shape
     hist = (
         jnp.stack([h_left, h_right])  # [2, F, B, 3]

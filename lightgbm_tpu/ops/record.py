@@ -53,6 +53,12 @@ import os as _os
 # partition tile width; larger tiles halve the placement-scan step count
 # at quadratically more (cheap) MXU routing work per tile
 TILE = int(_os.environ.get("LGBM_TPU_REC_TILE", "512"))
+if TILE <= 0 or TILE % 128 != 0:
+    raise ValueError(
+        f"LGBM_TPU_REC_TILE must be a positive multiple of 128 (Mosaic "
+        f"lane alignment; the compaction kernel's DMA offsets and the "
+        f"cap%TILE assert both require it), got {TILE}"
+    )
 
 
 def round_up(x: int, m: int) -> int:
