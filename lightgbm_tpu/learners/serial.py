@@ -48,6 +48,7 @@ import jax.numpy as jnp
 # trace time but the jit cache keys only on static args, so a mid-process
 # env flip would silently not apply to already-traced shapes (ADVICE r3).
 _KERN_ENV = _os.environ.get("LGBM_TPU_SEARCH_KERNEL", "pallas") != "jnp"
+_FUSE_HIST_ENV = _os.environ.get("LGBM_TPU_FUSE_HIST", "1") != "0"
 
 from ..models.tree import Tree, empty_tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
@@ -162,6 +163,15 @@ def _tier_chain(caps, gate_cnt, branch_fn):
 
         fn = tiered
     return fn(None)
+
+
+def _go_i32(fv, thr, is_cat):
+    """Left-going decision as i32 WITHOUT a bool intermediate: [cap]-ish
+    pred tensors bounce between bit layouts on this stack (round-3
+    measured ~80-100 ms/tree of pure copies at 1M rows)."""
+    isc = is_cat.astype(jnp.int32)
+    return isc * (fv == thr).astype(jnp.int32) + (1 - isc) * (
+        fv <= thr).astype(jnp.int32)
 
 
 def _partition_branch(order, bins_T, f, thr, is_cat, begin, pcnt, do_split, cap):
@@ -342,6 +352,7 @@ def grow_tree(
     # one launch) — unpooled only: the left child reuses the parent's
     # buffer row
     opt_fused = opt and not (0 < hist_pool < max_leaves)
+    fuse_hist = False  # set below when the record path qualifies
     if search_fn is None:
         search_fn = default_search_fn
         if search2_fn is None:
@@ -404,19 +415,43 @@ def grow_tree(
         # slice and the partition runs as the MXU block-compaction
         # kernel.  The round-3 profile showed the order-based path's
         # per-index gathers/scatters costing ~0.4 s/tree at 1M rows.
+        from ..ops.pallas_histogram import FGROUP as _FGROUP
+        from ..ops.pallas_search import (
+            _pack_meta as _search_pack_meta,
+            _pack_scal as _search_pack_scal,
+            _unpack as _search_unpack,
+        )
         from ..ops.record import (
             TILE as _REC_TILE,
             bins_per_word, build_record, extract_feature, num_words,
-            partition_window, rec_height, unpack_window,
+            partition_hist_window, partition_window, rec_height,
+            split_step_window, unpack_window,
         )
 
         k_pack = bins_per_word(bins_T.dtype)
         Wrec = rec_height(F, k_pack)
         _row_id_row = num_words(F, k_pack) + 3
+        _leaf_row = num_words(F, k_pack) + 4
         bin_dt = bins_T.dtype
         h_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in h_tiers}))
         p_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in p_tiers}))
         order_pad = max(p_tiers + h_tiers)
+        # fused partition+histogram kernel (ops/record.py
+        # partition_hist_window): the LEFT child's histogram accumulates
+        # inside the compaction launch, dropping the separate
+        # smaller-child histogram launch (~0.35 ms dispatch floor each,
+        # ~40% of the split loop's kernel count in the round-3 profile)
+        # and its whole h_tier cond chain.  Gated on the hist block
+        # fitting comfortably in VMEM next to the routing matrices.
+        _Bp = _round_up(num_bins, 128)
+        _Fp = _round_up(F, _FGROUP)
+        # LGBM_TPU_FUSE_HIST=0 is the A/B escape hatch (read at import
+        # like the other kernel knobs — see _KERN_ENV)
+        fuse_hist = _FUSE_HIST_ENV and _Fp * _Bp * 16 <= (1 << 21)
+        if fuse_hist:
+            # constant per tree: the search kernel's [Fp, 4] meta block
+            _mega_meta = _search_pack_meta(
+                feature_mask, num_bins_per_feature, is_categorical, _Fp)
     if child_counts_fn is None:
         _sum = (lambda x: x) if reduce_fn is None else reduce_fn
         _max = (lambda x: x) if reduce_max_fn is None else reduce_max_fn
@@ -599,14 +634,50 @@ def grow_tree(
         # here.
         begin = state.leaf_begin[best_leaf]
         pcnt = state.pos_cnt[best_leaf]
-        if opt_fused:
+        mega_res = None
+        if opt_fused and fuse_hist:
+            # MEGA split step: compaction + left-child histogram + both
+            # searches + in-place hists-row updates, ONE launch (the
+            # round-4 profile showed the loop bound by per-split
+            # dispatch, not op work).  depth gate + per-split scalars
+            # for the in-kernel search:
+            can_k = (params.max_depth <= 0) | (
+                t.leaf_depth[best_leaf] + 1 < params.max_depth)
+            scal_f = _search_pack_scal(
+                can_k.astype(jnp.float32),
+                state.best.left_sum_grad[best_leaf],
+                state.best.left_sum_hess[best_leaf],
+                state.best.left_count[best_leaf],
+                state.best.right_sum_grad[best_leaf],
+                state.best.right_sum_hess[best_leaf],
+                state.best.right_count[best_leaf],
+                params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
+                params.lambda_l1, params.lambda_l2,
+                params.min_gain_to_split,
+            )
+
+            def _mega_rec(cap):
+                fv = extract_feature(state.order, f, begin, cap, k_pack)
+                go = _go_i32(fv, thr, is_cat)
+                return split_step_window(
+                    state.hists, state.order, go, begin, pcnt, do_split,
+                    f, thr, is_cat, best_leaf, new_leaf, scal_f,
+                    _mega_meta, F=F, cap=cap,
+                    k=k_pack, fgroup=_FGROUP, interpret=_interp,
+                )
+
+            mega_hists, order, nleft, mega_res = _tier_chain(
+                p_tiers, state.gate_cnt[best_leaf], _mega_rec
+            )
+        elif opt_fused:
 
             def _part_rec(cap):
                 fv = extract_feature(state.order, f, begin, cap, k_pack)
-                go = jnp.where(is_cat, fv == thr, fv <= thr)
+                go = _go_i32(fv, thr, is_cat)
                 return partition_window(
                     state.order, go, begin, pcnt, do_split, cap,
-                    interpret=_interp,
+                    left_leaf=best_leaf, right_leaf=new_leaf,
+                    leaf_row=_leaf_row, interpret=_interp,
                 )
 
             order, nleft = _tier_chain(
@@ -660,7 +731,11 @@ def grow_tree(
         cnt_s = jnp.where(small_is_left, nleft, nright)
         cnt_s_gate = jnp.where(small_is_left, nleft_gate, nright_gate)
         begin_s = jnp.where(small_is_left, begin, begin + nleft)
-        if opt_fused:
+        if opt_fused and fuse_hist:
+            # mega path: histogram, subtract, search AND buffer update
+            # all happened inside split_step_window already
+            pass
+        elif opt_fused:
             # record mode: the child's rows are a CONTIGUOUS slice of
             # the leaf-sorted record — unpack (vector shifts) + kernel,
             # no indexed access at all
@@ -724,7 +799,12 @@ def grow_tree(
             h_parent = None if opt_fused else state.hists[best_leaf]
             h_prev_new = None if opt_fused else state.hists[new_leaf]
         depth_child = t.leaf_depth[best_leaf] + 1
-        if opt_fused:
+        if mega_res is not None:
+            # mega path: results come straight out of split_step_window
+            hists = mega_hists
+            best_l_new = _search_unpack(mega_res, 0)
+            best_r_new = _search_unpack(mega_res, 1)
+        elif opt_fused:
             # ---- ONE launch: subtract + child routing + both searches
             # + in-place buffer row updates (ops/pallas_search.py
             # _fused_kernel).  No [F, B]-sized intermediate exists as an
@@ -735,7 +815,8 @@ def grow_tree(
             can = (params.max_depth <= 0) | (depth_child < params.max_depth)
             hists, best_l_new, best_r_new = search2_update_pallas(
                 state.hists, h_small, best_leaf, new_leaf,
-                do_split, small_is_left,
+                do_split,
+                small_is_left,
                 lsg, lsh, lc, rsg, rsh, rc, can,
                 feature_mask, num_bins_per_feature, is_categorical,
                 params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
@@ -896,18 +977,25 @@ def grow_tree(
     # leaf of a position is a searchsorted over the (few) sorted begins,
     # then one unique-index scatter maps positions back to rows.
     tree = state.tree
-    idxL = jnp.arange(L, dtype=jnp.int32)
-    valid_leaf = (idxL < tree.num_leaves) & (state.pos_cnt > 0)
-    key = jnp.where(valid_leaf, state.leaf_begin, jnp.int32(n + order_pad))
-    perm = jnp.argsort(key).astype(jnp.int32)
-    sb = key[perm]
-    leaf_of_pos = perm[
-        jnp.searchsorted(sb, jnp.arange(n, dtype=jnp.int32), side="right") - 1
-    ]
-    rows = jnp.minimum(
-        state.order[_row_id_row, :n] if opt_fused else state.order[:n],
-        n - 1,
-    )
+    if opt_fused:
+        # record mode: the partition stamped every position's leaf id
+        # into the record's leaf-id row — one contiguous read replaces
+        # the searchsorted over leaf ranges (~75 ms/tree of
+        # binary-search gathers in the round-4 profile)
+        leaf_of_pos = state.order[_leaf_row, :n]
+        rows = jnp.minimum(state.order[_row_id_row, :n], n - 1)
+    else:
+        idxL = jnp.arange(L, dtype=jnp.int32)
+        valid_leaf = (idxL < tree.num_leaves) & (state.pos_cnt > 0)
+        key = jnp.where(
+            valid_leaf, state.leaf_begin, jnp.int32(n + order_pad))
+        perm = jnp.argsort(key).astype(jnp.int32)
+        sb = key[perm]
+        leaf_of_pos = perm[
+            jnp.searchsorted(
+                sb, jnp.arange(n, dtype=jnp.int32), side="right") - 1
+        ]
+        rows = jnp.minimum(state.order[:n], n - 1)
     leaf_id = (
         jnp.zeros(n, jnp.int32).at[rows].set(leaf_of_pos, unique_indices=True)
     )

@@ -74,11 +74,16 @@ def num_words(F: int, k: int) -> int:
 
 
 def rec_height(F: int, k: int) -> int:
-    """Record row count: packed words + 4 stat rows, padded to a
-    sublane-tile multiple of 8 — Mosaic DMA slices must be 8-aligned in
-    the sublane dimension, so the pad rows ride along for free instead
-    of a per-split pad/unpad pass."""
-    return round_up(num_words(F, k) + 4, 8)
+    """Record row count: packed words + 5 stat rows (grad, hess, mask,
+    row id, leaf id), padded to a sublane-tile multiple of 8 — Mosaic
+    DMA slices must be 8-aligned in the sublane dimension, so the pad
+    rows ride along for free instead of a per-split pad/unpad pass.
+
+    The LEAF-ID row rides the partition: each split stamps the two
+    child ids over the parent's window, so end-of-tree leaf assignment
+    is a contiguous row read instead of a searchsorted over the leaf
+    ranges (profiled ~75 ms/tree of binary-search gathers at 1M)."""
+    return round_up(num_words(F, k) + 5, 8)
 
 
 def pack_bins(bins_T: jax.Array, n_pad: int) -> jax.Array:
@@ -118,7 +123,7 @@ def build_record(
 
     F = bins_T.shape[0]
     k = bins_per_word(bins_T.dtype)
-    pad_rows = rec_height(F, k) - num_words(F, k) - 4
+    pad_rows = rec_height(F, k) - num_words(F, k) - 5
     return jnp.concatenate([
         pack_bins(bins_T, n_pad),
         stat_row(grad),
@@ -126,7 +131,8 @@ def build_record(
         stat_row(bag_mask),
         jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, n_pad - n),
                 constant_values=n)[None],
-        jnp.zeros((pad_rows, n_pad), jnp.int32),
+        # leaf-id row: every row starts in the root leaf (0)
+        jnp.zeros((1 + pad_rows, n_pad), jnp.int32),
     ])
 
 
@@ -157,21 +163,14 @@ def unpack_window(win: jax.Array, F: int, k: int, bin_dtype):
     return bins, g, h, m
 
 
-def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
-    """One grid step = one [W, T] tile: MXU one-hot stable compaction.
+def _compact_body(tile, g, W):
+    """Shared MXU one-hot stable-compaction math (used by both the plain
+    and the fused kernel): route tile columns so lefts land in [0, T)
+    and everything else in [T, 2T), original order inside each.
 
-    win_ref  [W, T] i32    : this tile of the record window
-    gcol_ref [T, 1] i32    : go flags (1 = left, valid only)
-    out_ref  [1, W, 2T] i32: lefts compacted to [0, T), everything else
-                             to [T, 2T), original order inside each
-
-    Placement at the (unaligned) global run offsets happens in an XLA
-    dynamic-update-slice scan outside — Mosaic DMA slices must be
-    128-lane aligned, which arbitrary compaction offsets are not.
+    tile [W, T] i32, g [T, 1] f32 (1.0 = left, valid only) -> [W, 2T].
     """
     T = TILE
-    g = gcol_ref[...].astype(jnp.float32)  # [T, 1]
-
     # strict-lower triangular: Lt[t, b] = 1.0 iff b < t; positions via
     # MXU dots (inputs 0/1 -> exact at any precision, f32 accumulation)
     t_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
@@ -187,7 +186,6 @@ def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
 
     hot = (pos == jax.lax.broadcasted_iota(jnp.int32, (T, 2 * T), 1)
            ).astype(jnp.float32)  # [T, 2T] routing matrix
-    tile = win_ref[...]  # [W, T] i32
     comp = jnp.zeros((W, 2 * T), jnp.int32)
     for b in range(4):
         byte = ((tile >> (8 * b)) & 0xFF).astype(jnp.float32)
@@ -195,17 +193,440 @@ def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
             byte, hot, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [W, 2T]
         comp = comp | (m.astype(jnp.int32) << (8 * b))
-    out_ref[0] = comp
+    return comp
 
 
-@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
+    """One grid step = one [W, T] tile: MXU one-hot stable compaction.
+
+    win_ref  [W, T] i32    : this tile of the record window
+    gcol_ref [T, 1] i32    : go flags (1 = left, valid only)
+    out_ref  [1, W, 2T] i32: lefts compacted to [0, T), everything else
+                             to [T, 2T), original order inside each
+
+    Placement at the (unaligned) global run offsets happens in an XLA
+    dynamic-update-slice scan outside — Mosaic DMA slices must be
+    128-lane aligned, which arbitrary compaction offsets are not.
+    """
+    out_ref[0] = _compact_body(
+        win_ref[...], gcol_ref[...].astype(jnp.float32), W)
+
+
+
+def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp):
+    """Shared left-child histogram accumulation over one [W, T] record
+    tile (used by _compact_hist_kernel and _split_step_kernel).  The
+    split decision is recomputed from scalars in ROW layout; stats stack
+    on sublanes; the one-hot is born transposed against a sublane iota
+    and contracts the shared lane axis on the MXU — no relayouts.
+
+    ``hacc_set(fi, contrib)`` accumulates [4, Bp] into feature row fi.
+    scal_i layout: (.., .., .., .., f, thr, is_cat, pcnt) — indices 4-7.
+    """
+    T = TILE
+    f = scal_i_ref[4]
+    thr = scal_i_ref[5]
+    is_cat = scal_i_ref[6]
+    pcnt = scal_i_ref[7]
+    shift = 32 // k
+    mask_v = (1 << shift) - 1
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    valid = ((i * T + lane) < pcnt).astype(jnp.int32)
+    fw = f // k
+    fs = (f % k) * shift
+    # static compare-select row pick: Mosaic has no dynamic_slice
+    # lowering, and a dynamically-indexed sublane load is the failure
+    # class the histogram kernel's FGROUP loop dodges
+    frow = jnp.zeros((1, T), jnp.int32)
+    for w in range(num_words(F, k)):
+        frow = frow + jnp.where(fw == w, tile[w: w + 1, :], 0)
+    fv = jax.lax.shift_right_logical(frow, fs) & mask_v
+    # ARITHMETIC select: an i1-on-i1 arith.select fails legalization
+    go = is_cat * (fv == thr).astype(jnp.int32) + (1 - is_cat) * (
+        fv <= thr).astype(jnp.int32)
+    govf = (go * valid).astype(jnp.float32)
+
+    Wb = num_words(F, k)
+    grow = jax.lax.bitcast_convert_type(tile[Wb: Wb + 1, :], jnp.float32)
+    hrow = jax.lax.bitcast_convert_type(
+        tile[Wb + 1: Wb + 2, :], jnp.float32)
+    mrow = jax.lax.bitcast_convert_type(
+        tile[Wb + 2: Wb + 3, :], jnp.float32)
+    mw = mrow * govf  # bagging mask restricted to the left child
+    stats4 = jnp.concatenate(
+        [grow * mw, hrow * mw, mw, jnp.zeros_like(mw)], axis=0)
+
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (Bp, T), 0)
+    Fp = round_up(F, 8)
+    for fi in range(F):
+        w_idx, sh = fi // k, (fi % k) * shift
+        row = jax.lax.shift_right_logical(
+            tile[w_idx: w_idx + 1, :], sh) & mask_v
+        onehot = (row == iota_s).astype(jnp.float32)
+        contrib = jax.lax.dot_general(
+            stats4, onehot, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        hacc_set(fi, contrib)
+    if Fp > F:
+        # padded features: bin-0 totals, matching _prep_single_leaf's
+        # zero-padded feature rows (subtract consistency with the
+        # buffer's existing rows)
+        zrow = jnp.zeros((1, T), jnp.int32)
+        onehot0 = (zrow == iota_s).astype(jnp.float32)
+        contrib0 = jax.lax.dot_general(
+            stats4, onehot0, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        for fi in range(F, Fp):
+            hacc_set(fi, contrib0)
+
+
+def _compact_hist_kernel(
+    scal_ref, win_ref, gcol_ref, out_ref, hist_ref, *, W, F, k, Bp
+):
+    """_compact_kernel + LEFT-child histogram accumulation in ONE launch.
+
+    The round-3 profile (BASELINE.md) showed ~0.35 ms PER Pallas launch
+    of pure dispatch cost; the separate smaller-child histogram launch
+    was ~40% of the split loop's kernel count.  The left child's
+    histogram is a sum over exactly the rows this kernel is already
+    routing — so accumulate it here, in the raw [Fp, 4, Bp] layout the
+    search kernel wants, and let the sibling come from the parent by
+    subtraction (feature_histogram.hpp:97-106) as before.  The larger
+    child is no longer necessarily the subtracted one — equivalent under
+    exact arithmetic, and cheaper than a second launch.
+
+    scal_ref [4] i32      : (f, thr, is_cat, pcnt) — split feature/
+                            threshold (clamped f>=0) and the parent's
+                            positional count for validity
+    win_ref  [W, T] i32   : this tile of the record window
+    gcol_ref [T, 1] i32   : go flags (left, valid only) for routing
+    out_ref  [1, W, 2T]   : compacted tile (see _compact_kernel)
+    hist_ref [1, Fp, 4, Bp] f32: left-child histogram, SAME block every
+                            grid step (VMEM-resident accumulator)
+
+    All histogram math stays in ROW layout (bins live in lanes): the
+    one-hot is born transposed against a sublane iota and contracts the
+    shared lane axis on the MXU — no [1,T]->[T,1] relayouts anywhere.
+    """
+    T = TILE
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+
+    tile = win_ref[...]  # [W, T] i32
+    out_ref[0] = _compact_body(
+        tile, gcol_ref[...].astype(jnp.float32), W)
+
+    def hacc_set(fi, contrib):
+        hist_ref[0, fi] = hist_ref[0, fi] + contrib
+
+    _hist_tile_body(tile, scal_ref, hacc_set, i, W=W, F=F, k=k, Bp=Bp)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("F", "cap", "num_bins", "k", "fgroup",
+                              "interpret")
+)
+def partition_hist_window(
+    rec: jax.Array,  # [W, n_pad] i32
+    go: jax.Array,  # [cap] i32: left-going (valid rows only)
+    begin: jax.Array,
+    pcnt: jax.Array,
+    do_split: jax.Array,
+    f: jax.Array,  # split feature (clamped >= 0 on no-op steps)
+    thr: jax.Array,  # split threshold bin
+    is_cat: jax.Array,  # bool
+    F: int,
+    cap: int,
+    num_bins: int,
+    k: int,  # bins per word (4 for u8 bins, 2 for u16)
+    left_leaf: jax.Array | None = None,  # stamp into the leaf-id row
+    right_leaf: jax.Array | None = None,
+    fgroup: int = 8,
+    interpret: bool = False,
+):
+    """partition_window + left-child histogram in the SAME kernel launch.
+
+    Returns (rec', nleft, hist_left[Fp, 4, Bp]) with Fp = F padded to
+    ``fgroup`` and Bp = bins padded to a lane multiple — the raw layout
+    of ops/pallas_histogram histogram_single_leaf_raw, so the split step
+    feeds the search kernel with no extra launch and no relayout.
+
+    With ``left_leaf``/``right_leaf`` given, the record's leaf-id row
+    (row num_words+4) is stamped with the child ids over the parent's
+    valid range — the partition IS the leaf assignment (see rec_height).
+    """
+    W = rec.shape[0]
+    T = TILE
+    assert cap % T == 0, (cap, T)
+    nt = cap // T
+    Bp = round_up(num_bins, 128)
+    Fp = round_up(F, fgroup)
+
+    win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    # integer arithmetic end-to-end: [cap]/[cap,1] pred tensors pay
+    # bit-layout relayout copies on this stack (profiled ~100 ms/tree
+    # at 1M; callers pass go as i32 via serial._go_i32)
+    valid = (iota < pcnt).astype(jnp.int32)
+    gov = jnp.asarray(go).astype(jnp.int32) * valid
+    nleft = jnp.sum(gov, dtype=jnp.int32)
+
+    kt = gov.reshape(nt, T)
+    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
+    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
+    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
+    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+
+    scal = jnp.stack([
+        jnp.maximum(f, 0).astype(jnp.int32),
+        thr.astype(jnp.int32),
+        is_cat.astype(jnp.int32),
+        pcnt.astype(jnp.int32),
+    ])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((W, T), lambda i, s: (0, i)),
+            pl.BlockSpec((T, 1), lambda i, s: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, W, 2 * T), lambda i, s: (i, 0, 0)),
+            pl.BlockSpec((1, Fp, 4, Bp), lambda i, s: (0, 0, 0, 0)),
+        ],
+    )
+    comp, hist = pl.pallas_call(
+        functools.partial(_compact_hist_kernel, W=W, F=F, k=k, Bp=Bp),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
+            jax.ShapeDtypeStruct((1, Fp, 4, Bp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scal, win, gov.reshape(cap, 1))
+
+    def place(carry, x):
+        lbuf, rbuf = carry
+        c, lo, ro = x
+        lbuf = jax.lax.dynamic_update_slice(lbuf, c[:, :T], (0, lo))
+        rbuf = jax.lax.dynamic_update_slice(rbuf, c[:, T:], (0, ro))
+        return (lbuf, rbuf), None
+
+    buf0 = jnp.zeros((W, cap + T), jnp.int32)
+    (lbuf, rbuf), _ = jax.lax.scan(place, (buf0, buf0), (comp, loff, roff))
+
+    rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
+    is_left = (iota < nleft).astype(jnp.int32)[None, :]
+    merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
+    keep = (valid * do_split.astype(jnp.int32))[None, :]
+    out = merged * keep + win * (1 - keep)
+    if left_leaf is not None:
+        # stamp child leaf ids over the parent's (kept) range: after the
+        # roll, [0, nleft) is the left child and [nleft, pcnt) the right
+        lr = num_words(F, k) + 4
+        leafvals = (is_left[0] * left_leaf.astype(jnp.int32)
+                    + (1 - is_left[0]) * right_leaf.astype(jnp.int32))
+        out = out.at[lr].set(keep[0] * leafvals + (1 - keep[0]) * out[lr])
+    rec2 = jax.lax.dynamic_update_slice(rec, out, (0, begin))
+    return rec2, nleft, hist[0]
+
+
+def _split_step_kernel(
+    scal_i_ref, scal_f_ref, win_ref, gcol_ref, hrow_ref, meta_ref,
+    hists_out_ref, comp_ref, res_ref, hacc_ref,
+    *, W, F, k, Bp, nt,
+):
+    """The WHOLE split step in one launch: per-tile MXU compaction +
+    left-child histogram accumulation (steps 0..nt-1), then subtract +
+    two-child search + in-place histogram-buffer row updates (steps nt
+    and nt+1) — the union of _compact_hist_kernel and
+    pallas_search._fused_kernel, eliminating one ~0.35 ms launch floor
+    plus the [Fp, 4, Bp] h_small round trip through HBM per split.
+
+    scal_i [8]: (parent_slot, left_slot, new_slot, do_split, f, thr,
+                 is_cat, pcnt)
+    scal_f [16]: pallas_search._pack_scal layout
+    hrow_ref   : hists row — parent slot for steps <= nt, new slot after
+    hists_out  : left row at step nt, right row at step nt+1
+    hacc_ref   : VMEM scratch — left-child histogram accumulator, then
+                 the right-child stash between steps nt and nt+1
+    """
+    from .pallas_search import K_EPSILON, _child_search, _tail_of, _tri
+
+    T = TILE
+    i = pl.program_id(0)
+    do_split = scal_i_ref[3] > 0
+
+    @pl.when(i == 0)
+    def _():
+        hacc_ref[...] = jnp.zeros_like(hacc_ref)
+
+    @pl.when(i < nt)
+    def _():
+        # the output block aliases the PARENT row during tile steps
+        # (si[1] == si[0]); pass the parent through so any intermediate
+        # writeback (interpret mode flushes every step) is an identity
+        # write, never garbage over a row the search still needs
+        hists_out_ref[0] = hrow_ref[0]
+        tile = win_ref[...]  # [W, T] i32
+        comp_ref[0] = _compact_body(
+            tile, gcol_ref[...].astype(jnp.float32), W)
+
+        def hacc_set(fi, contrib):
+            hacc_ref[fi] = hacc_ref[fi] + contrib
+
+        _hist_tile_body(tile, scal_i_ref, hacc_set, i, W=W, F=F, k=k, Bp=Bp)
+
+    @pl.when(i == nt)
+    def _():
+        parent = hrow_ref[0]  # [Fp, 4, Bp]
+        h_left = hacc_ref[...]
+        h_right = parent - h_left
+        hists_out_ref[0] = jnp.where(do_split, h_left, parent)
+        hacc_ref[...] = h_right  # stash for the final step
+
+        B = Bp
+        tri = _tri(B)
+        for cc in range(2):
+            side = (h_left, h_right)[cc]
+            hg, hh, hc = side[:, 0, :], side[:, 1, :], side[:, 2, :]
+            _child_search(
+                cc, hg, hh, hc,
+                _tail_of(hg, tri), _tail_of(hh, tri) + K_EPSILON,
+                _tail_of(hc, tri),
+                scal_f_ref, meta_ref, res_ref, hacc_ref.shape[0], B,
+            )
+
+    @pl.when(i == nt + 1)
+    def _():
+        hists_out_ref[0] = jnp.where(do_split, hacc_ref[...], hrow_ref[0])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("F", "cap", "k", "fgroup", "interpret"),
+    donate_argnums=(0,),
+)
+def split_step_window(
+    hists,  # [P, Fp, 4, Bp] f32 — DONATED, rows updated in place
+    rec,  # [W, n_pad] i32
+    go,  # [cap] i32: left-going (valid rows only)
+    begin, pcnt, do_split,
+    f, thr, is_cat,  # split decision scalars
+    parent_slot, new_slot,  # hists rows (left child reuses parent's)
+    scal_f,  # [16] f32 — pallas_search._pack_scal layout
+    meta,  # [Fp, 4] — pallas_search._pack_meta
+    F: int, cap: int, k: int,
+    fgroup: int = 8,
+    interpret: bool = False,
+):
+    """One-launch split step over window [begin, begin+cap): compaction
+    + left-child histogram + subtract + two-child search + in-place
+    hists-row updates.  Returns (hists', rec', nleft, res[2, 16]).
+
+    The child leaf ids are stamped into the record's leaf-id row (see
+    rec_height); placement of the compacted runs stays in the XLA DUS
+    scan (Mosaic DMA lane alignment).
+    """
+    W = rec.shape[0]
+    T = TILE
+    assert cap % T == 0, (cap, T)
+    nt = cap // T
+    P, Fp, _, Bp = hists.shape
+
+    win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = (iota < pcnt).astype(jnp.int32)
+    gov = jnp.asarray(go).astype(jnp.int32) * valid
+    nleft = jnp.sum(gov, dtype=jnp.int32)
+
+    kt = gov.reshape(nt, T)
+    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
+    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
+    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
+    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+
+    i32 = functools.partial(jnp.asarray, dtype=jnp.int32)
+    scal_i = jnp.stack([
+        i32(parent_slot), i32(parent_slot), i32(new_slot), i32(do_split),
+        jnp.maximum(i32(f), 0), i32(thr), i32(is_cat), i32(pcnt)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nt + 2,),
+        in_specs=[
+            pl.BlockSpec((W, T), lambda i, si, sf: (0, jnp.minimum(i, nt - 1))),
+            pl.BlockSpec((T, 1), lambda i, si, sf: (jnp.minimum(i, nt - 1), 0)),
+            pl.BlockSpec(
+                (1, Fp, 4, Bp),
+                lambda i, si, sf: (jnp.where(i <= nt, si[0], si[2]),
+                                   0, 0, 0)),
+            pl.BlockSpec((Fp, 4), lambda i, si, sf: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, Fp, 4, Bp),
+                lambda i, si, sf: (jnp.where(i <= nt, si[1], si[2]),
+                                   0, 0, 0)),
+            pl.BlockSpec((1, W, 2 * T),
+                         lambda i, si, sf: (jnp.minimum(i, nt - 1), 0, 0)),
+            pl.BlockSpec((2, 16), lambda i, si, sf: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((Fp, 4, Bp), jnp.float32)],
+    )
+    hists_new, comp, res = pl.pallas_call(
+        functools.partial(
+            _split_step_kernel, W=W, F=F, k=k, Bp=Bp, nt=nt),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((P, Fp, 4, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
+            jax.ShapeDtypeStruct((2, 16), jnp.float32),
+        ],
+        input_output_aliases={4: 0},  # hists (incl. the 2 prefetch args)
+        interpret=interpret,
+    )(scal_i, scal_f, win, gov.reshape(cap, 1), hists, meta)
+
+    def place(carry, x):
+        lbuf, rbuf = carry
+        c, lo, ro = x
+        lbuf = jax.lax.dynamic_update_slice(lbuf, c[:, :T], (0, lo))
+        rbuf = jax.lax.dynamic_update_slice(rbuf, c[:, T:], (0, ro))
+        return (lbuf, rbuf), None
+
+    buf0 = jnp.zeros((W, cap + T), jnp.int32)
+    (lbuf, rbuf), _ = jax.lax.scan(place, (buf0, buf0), (comp, loff, roff))
+
+    rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
+    is_left = (iota < nleft).astype(jnp.int32)[None, :]
+    merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
+    keep = (valid * do_split.astype(jnp.int32))[None, :]
+    out = merged * keep + win * (1 - keep)
+    lr = num_words(F, k) + 4
+    leafvals = (is_left[0] * parent_slot.astype(jnp.int32)
+                + (1 - is_left[0]) * new_slot.astype(jnp.int32))
+    out = out.at[lr].set(keep[0] * leafvals + (1 - keep[0]) * out[lr])
+    rec2 = jax.lax.dynamic_update_slice(rec, out, (0, begin))
+    return hists_new, rec2, nleft, res
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap", "leaf_row", "interpret"))
 def partition_window(
     rec: jax.Array,  # [W, n_pad] i32
-    go: jax.Array,  # [cap] bool: left-going (valid rows only)
+    go: jax.Array,  # [cap] i32: left-going (valid rows only)
     begin: jax.Array,
     pcnt: jax.Array,
     do_split: jax.Array,
     cap: int,
+    left_leaf: jax.Array | None = None,
+    right_leaf: jax.Array | None = None,
+    leaf_row: int = -1,  # record row to stamp child leaf ids into
     interpret: bool = False,
 ):
     """Stably partition window [begin, begin+cap) of ``rec``: the
@@ -214,6 +635,8 @@ def partition_window(
     inside the static tier window, or the n_pad tail — are preserved
     exactly.  Returns (rec', nleft).  DataPartition::Split
     (data_partition.hpp:91-139) re-designed for the TPU memory system.
+    With ``leaf_row`` >= 0 the child leaf ids are stamped over the
+    parent's kept range (see rec_height's leaf-id row).
     """
     W = rec.shape[0]
     T = TILE
@@ -222,10 +645,11 @@ def partition_window(
 
     win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
     iota = jnp.arange(cap, dtype=jnp.int32)
-    valid = iota < pcnt
     # i32 from the start: pred (1-bit) arrays at [cap, 1]-ish shapes
-    # bounce between bit layouts (measured ~80 ms/tree of copies)
-    gov = (go & valid).astype(jnp.int32)
+    # bounce between bit layouts (measured ~80-100 ms/tree of copies;
+    # callers pass go as i32 via serial._go_i32)
+    valid = (iota < pcnt).astype(jnp.int32)
+    gov = jnp.asarray(go).astype(jnp.int32) * valid
     nleft = jnp.sum(gov, dtype=jnp.int32)
 
     kt = gov.reshape(nt, T)
@@ -234,8 +658,7 @@ def partition_window(
     # the window, so within any tile valid rights precede invalids and
     # each right-run's valid prefix lands at the right global offset;
     # the garbage beyond total-valid-rights is cut by the final selects
-    cr = jnp.sum(valid.reshape(nt, T).astype(jnp.int32) - kt,
-                 axis=1, dtype=jnp.int32)
+    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
     loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
     roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
 
@@ -272,6 +695,11 @@ def partition_window(
     rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
     is_left = (iota < nleft).astype(jnp.int32)[None, :]
     merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
-    keep = (valid.astype(jnp.int32) * do_split.astype(jnp.int32))[None, :]
+    keep = (valid * do_split.astype(jnp.int32))[None, :]
     out = merged * keep + win * (1 - keep)
+    if leaf_row >= 0 and left_leaf is not None:
+        leafvals = (is_left[0] * left_leaf.astype(jnp.int32)
+                    + (1 - is_left[0]) * right_leaf.astype(jnp.int32))
+        out = out.at[leaf_row].set(
+            keep[0] * leafvals + (1 - keep[0]) * out[leaf_row])
     return jax.lax.dynamic_update_slice(rec, out, (0, begin)), nleft

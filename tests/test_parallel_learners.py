@@ -122,3 +122,79 @@ def test_feature_and_voting_parallel_matmul_hist():
         np.testing.assert_array_equal(
             np.asarray(t_seg.threshold_bin), np.asarray(t_mm.threshold_bin)
         )
+
+
+def _informative_problem(n, F, B, n_inform, seed=0):
+    """Wide-feature problem where only ``n_inform`` features carry
+    signal: gradients follow feature 0..n_inform-1's bins, the rest is
+    noise — the shape PV-Tree's vote exists for
+    (voting_parallel_tree_learner.cpp:137-166)."""
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+    signal = sum(
+        (bins[j] / B - 0.5) * (1.0 - 0.1 * j) for j in range(n_inform)
+    )
+    grad = (signal + 0.3 * rng.randn(n)).astype(np.float32)
+    return (
+        jnp.asarray(bins),
+        jnp.asarray(grad),
+        jnp.asarray(np.ones(n, np.float32)),
+        jnp.ones(n, jnp.float32),
+        jnp.ones(F, bool),
+        jnp.full(F, B, jnp.int32),
+        jnp.zeros(F, bool),
+    )
+
+
+def _total_gain(tree) -> float:
+    nl = int(tree.num_leaves)
+    return float(np.asarray(tree.split_gain)[: nl - 1].sum())
+
+
+def test_voting_parallel_restricted_top_k_quality():
+    """PV-Tree at top_k < F (the configuration the algorithm exists
+    for): the vote restricts which histograms are reduced, so trees may
+    differ from data-parallel — but on data whose signal lives in few
+    features, the voted tree's quality (total split gain) must stay
+    within a small factor of the full-communication learner's
+    (voting_parallel_tree_learner.cpp:137-166: the PV-Tree paper's
+    claim is near-lossless accuracy at top_k ~ 20 on wide data)."""
+    n, F, B, L = 2048, 64, 16, 15
+    args = _informative_problem(n, F, B, n_inform=4, seed=11)
+    params = _params()
+
+    t_s, _ = grow_tree(*args, params, num_bins=B, max_leaves=L)
+    full_gain = _total_gain(t_s)
+    assert full_gain > 0
+
+    for top_k, floor in ((5, 0.95), (10, 0.95), (20, 0.95)):
+        grow_v = make_voting_parallel_grower(
+            data_mesh(), num_bins=B, max_leaves=L, top_k=top_k
+        )
+        t_v, _ = grow_v(*args, params)
+        gain = _total_gain(t_v)
+        assert int(t_v.num_leaves) > 4
+        assert gain >= floor * full_gain, (
+            f"top_k={top_k}: voted gain {gain:.2f} < "
+            f"{floor} * full {full_gain:.2f}"
+        )
+
+
+def test_voting_parallel_restricted_on_noise_features():
+    """With signal in 4 of 64 features, a top_k=5 vote (k2=10 reduced
+    features per split out of 64) must still find the informative
+    features for the FIRST split — the vote's count-weighting should
+    surface globally-informative features despite shard noise."""
+    n, F, B, L = 2048, 64, 16, 7
+    args = _informative_problem(n, F, B, n_inform=4, seed=3)
+    params = _params()
+    t_s, _ = grow_tree(*args, params, num_bins=B, max_leaves=L)
+    grow_v = make_voting_parallel_grower(
+        data_mesh(), num_bins=B, max_leaves=L, top_k=5
+    )
+    t_v, _ = grow_v(*args, params)
+    # root split feature must be informative (one of the 4 signal cols)
+    root_s = int(np.asarray(t_s.split_feature)[0])
+    root_v = int(np.asarray(t_v.split_feature)[0])
+    assert root_s < 4
+    assert root_v < 4

@@ -82,11 +82,17 @@ def train_ours(X, y, cat_idx):
     ds = lgb.Dataset(X, label=y, categorical_feature=cat_idx or None)
     # warm the jit caches (first-iteration compile must not ride the
     # steady-state s/tree; the lru-cached hist/search factories make the
-    # second train compile-free at the same shapes)
+    # second train compile-free at the same shapes).  Cold vs warm is
+    # printed explicitly so a published row can never silently contain
+    # compile time (VERDICT r3 item 9).
+    t0 = time.perf_counter()
     lgb.train(params, ds, num_boost_round=2)
+    cold_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     bst = lgb.train(params, ds, num_boost_round=TREES)
     elapsed = time.perf_counter() - t0
+    log(f"  cold (2 trees + compile): {cold_s:.2f}s; "
+        f"warm: {elapsed / TREES:.4f}s/tree x {TREES}")
     pred = bst.predict(X, raw_score=True)
     return elapsed / TREES, auc(y, np.asarray(pred))
 

@@ -106,11 +106,15 @@ def main():
 
     _bench.apply_tuned_defaults()
     ds = lgb.Dataset(X, label=y, group=sizes)
-    # warm the jit caches: first-iteration compile must not ride s/tree
+    # warm the jit caches: first-iteration compile must not ride s/tree.
+    # Cold vs warm is printed explicitly (VERDICT r3 item 9).
+    t0 = time.perf_counter()
     lgb.train(params, ds, num_boost_round=2)
+    cold_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     bst = lgb.train(params, ds, num_boost_round=TREES)
     ours_s = (time.perf_counter() - t0) / TREES
+    log(f"cold (2 trees + compile): {cold_s:.2f}s; warm: {ours_s:.4f}s/tree")
     pred = np.asarray(bst.predict(X, raw_score=True))
     ours_ndcg = ndcg_at_10(pred, y, sizes)
     results["ours"] = {"sec_per_tree": round(ours_s, 4),

@@ -45,8 +45,57 @@ COLLECTIVE_RE = re.compile(
 )
 
 
+SHAPE_RE = re.compile(r"([a-z]+[0-9]+)\[([0-9,]*)\]")
+_DT_BYTES = {"f32": 4, "f64": 8, "s32": 4, "u32": 4, "pred": 1, "bf16": 2,
+             "s8": 1, "u8": 1, "f16": 2, "s64": 8, "u64": 8, "u16": 2,
+             "s16": 2}
+
+
+def _bytes_of(line: str) -> int:
+    """Sum ALL result-shape components: variadic (combined) collectives
+    have tuple results like `(f32[64,32], s32[4]) all-reduce(...)`."""
+    lhs = line.split("=", 1)[-1]
+    # result shapes precede the op name; operands repeat shapes, so cut
+    # at the opening paren of the operand list (after the op keyword)
+    m_op = COLLECTIVE_RE.search(lhs)
+    head = lhs[: m_op.start()] if m_op else lhs
+    total = 0
+    for dt, dims in SHAPE_RE.findall(head):
+        num = 1
+        for d in dims.split(","):
+            if d:
+                num *= int(d)
+        total += num * _DT_BYTES.get(dt, 4)
+    return total
+
+
+def report(tag: str, hlo: str) -> None:
+    """Per-computation collective counts + payload bytes.  The while body
+    (executed num_leaves-1 times) is the per-split budget."""
+    blocks: dict[str, list[str]] = {}
+    cur = None
+    for line in hlo.splitlines():
+        if line and not line.startswith(" ") and "{" in line:
+            cur = line.split("{")[0].strip().split(" ")[0]
+            blocks[cur] = []
+        elif cur is not None:
+            blocks[cur].append(line)
+    for name, lines in blocks.items():
+        counts: dict[str, int] = {}
+        nbytes = 0
+        for ln in lines:
+            m = COLLECTIVE_RE.search(ln)
+            if m and "-done" not in ln.split("=", 1)[-1][:40] and "=" in ln:
+                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+                nbytes += _bytes_of(ln)
+        if counts:
+            where = "ENTRY (per-tree setup)" if name.startswith("ENTRY") \
+                else f"{name} (per-split while body)"
+            print(f"[{tag}] {where}: {counts}  payload={nbytes}B")
+
+
 def main() -> None:
-    n, F, B, L = 4096, 12, 32, 15  # small L: the while BODY is what we count
+    n, F, B, L = 4096, 64, 32, 15  # small L: the while BODY is what we count
     rng = np.random.RandomState(0)
     args = (
         jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8)),
@@ -60,28 +109,19 @@ def main() -> None:
     )
     mesh = data_mesh()
     grow = make_data_parallel_grower(mesh, num_bins=B, max_leaves=L)
-    hlo = jax.jit(grow).lower(*args).compile().as_text()
+    report("data-parallel F=64",
+           jax.jit(grow).lower(*args).compile().as_text())
 
-    # per-computation counts: the while body (the per-split cost, executed
-    # num_leaves-1 times) is the non-ENTRY computation holding collectives
-    blocks: dict[str, list[str]] = {}
-    cur = None
-    for line in hlo.splitlines():
-        if line and not line.startswith(" ") and "{" in line:
-            cur = line.split("{")[0].strip().split(" ")[0]
-            blocks[cur] = []
-        elif cur is not None:
-            blocks[cur].append(line)
-    for name, lines in blocks.items():
-        counts: dict[str, int] = {}
-        for ln in lines:
-            m = COLLECTIVE_RE.search(ln)
-            if m and "-done" not in ln.split("=", 1)[-1][:40] and "=" in ln:
-                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
-        if counts:
-            tag = "ENTRY (per-tree setup)" if name.startswith("ENTRY") \
-                else f"{name} (per-split while body)"
-            print(f"{tag}: {counts}")
+    # voting-parallel (PV-Tree): the vote restricts the reduced histogram
+    # payload from O(F*B) to O(2*top_k*B)
+    # (voting_parallel_tree_learner.cpp:137-166, 260-265)
+    from lightgbm_tpu.parallel import make_voting_parallel_grower
+
+    for top_k in (5, 20):
+        grow_v = make_voting_parallel_grower(
+            mesh, num_bins=B, max_leaves=L, top_k=top_k)
+        report(f"voting top_k={top_k} F=64",
+               jax.jit(grow_v).lower(*args).compile().as_text())
 
 
 if __name__ == "__main__":

@@ -108,8 +108,12 @@ def run_ours(Xtr, ytr, Xva, yva) -> dict:
 
     obj = create_objective(cfg, ds.metadata, ds.num_data)
     booster = GBDT(cfg, ds, obj)
-    if va is not None:
-        booster.add_valid_dataset(va, "valid")
+    # NOTE: the valid set is attached AFTER training (add_valid_dataset
+    # replays the whole model onto the valid scores in one stacked
+    # program).  Attaching it up front puts a per-tree binned ensemble
+    # walk over the 1M valid rows INSIDE the training loop — measured
+    # ~3x the tree-growth cost itself at the 10M/255-leaf shape (the
+    # walk is depth x 1M indexed gathers per tree).
 
     t0 = time.perf_counter()
     booster.train_one_iter()
@@ -138,8 +142,6 @@ def run_ours(Xtr, ytr, Xva, yva) -> dict:
                 "train_auc": round(booster.eval_at(0)["auc"], 6),
                 "elapsed_s": round(now - t_wall0, 1),
             }
-            if va is not None:
-                evals["valid_auc"] = round(booster.eval_at(1)["auc"], 6)
             evals.update(hbm_stats())
             emit_progress(evals)
             log(f"progress: {evals}")
@@ -160,7 +162,10 @@ def run_ours(Xtr, ytr, Xva, yva) -> dict:
         "train_auc": round(booster.eval_at(0)["auc"], 6),
     }
     if va is not None:
+        t0 = time.perf_counter()
+        booster.add_valid_dataset(va, "valid")  # replays the full model
         out["valid_auc"] = round(booster.eval_at(1)["auc"], 6)
+        out["valid_replay_s"] = round(time.perf_counter() - t0, 1)
     out.update(hbm_stats())
     booster.save_model_to_file("/tmp/northstar_model.txt")
     return out
@@ -175,8 +180,18 @@ def run_reference(Xtr, ytr, Xva, yva) -> dict:
         return {"ref_error": "reference CLI unavailable"}
     # "v2": the original run wrote this CSV from a sliced-draw variant of
     # the generator; the n_valid split draws different labels, so the two
-    # data versions must never share a cache path
+    # data versions must never share a cache path.  bench.py CSVs hold
+    # the SAME train rows (make_data keeps the train draw bit-identical
+    # under n_valid) — reuse one if present instead of a multi-minute
+    # 10M-row savetxt.
+    import glob
+
     data_path = f"/tmp/ns_ref_{ROWS}_v2.csv"
+    if not os.path.exists(data_path):
+        for cand in sorted(glob.glob(f"/tmp/bench_r{ROWS}_t*_l255_b255.csv")):
+            log(f"reusing bench CSV {cand}")
+            os.link(cand, data_path)
+            break
     if not os.path.exists(data_path):
         log("writing reference CSV ...")
         np.savetxt(data_path, np.column_stack([ytr, Xtr]), fmt="%.6g",

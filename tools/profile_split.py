@@ -124,11 +124,12 @@ def main():
                 self_ms = max(0.0, dur - e["_entry"]["child"]) / 1e3
                 name = e.get("name", "?")
                 key = re.sub(r"[.\d]+$", "", name) or name
-                if key == "fusion" and e["_long"]:
-                    # split the fusion bucket by output-shape signature
+                if key in ("fusion", "copy") and e["_long"]:
+                    # split the fusion/copy buckets by output-shape
+                    # signature (one 'copy' group hid which layouts pay)
                     sig = re.search(r"= ([^)]{0,70})", e["_long"])
                     if sig:
-                        key = "fusion " + re.sub(
+                        key = key + " " + re.sub(
                             r"\{[^}]*\}", "", sig.group(1))[:60]
                 rec = by_name.setdefault(
                     key, {"ms": 0.0, "n": 0, "ex": "", "long": ""})
