@@ -168,6 +168,11 @@ class Config:
     bin_construct_sample_cnt: int = 50000
     is_pre_partition: bool = False
     is_enable_sparse: bool = True
+    # density below which the depthwise histogram switches to the O(nnz)
+    # CSR path (ops/sparse_hist.py; reference ordered_sparse_bin.hpp:79-92
+    # uses sparse_rate >= 0.8 per feature, i.e. density <= 0.2 — this is
+    # the whole-dataset analog, conservative by default)
+    sparse_hist_density: float = 0.05
     # when false, ignore an existing <data>.bin cache (config.h:107)
     enable_load_from_binary_file: bool = True
     use_two_round_loading: bool = False

@@ -424,8 +424,8 @@ def grow_tree(
         from ..ops.record import (
             TILE as _REC_TILE,
             bins_per_word, build_record, extract_feature, num_words,
-            partition_hist_window, partition_window, rec_height,
-            split_step_window, unpack_window,
+            partition_window, rec_height, split_step_window,
+            unpack_window,
         )
 
         k_pack = bins_per_word(bins_T.dtype)
@@ -436,13 +436,12 @@ def grow_tree(
         h_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in h_tiers}))
         p_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in p_tiers}))
         order_pad = max(p_tiers + h_tiers)
-        # fused partition+histogram kernel (ops/record.py
-        # partition_hist_window): the LEFT child's histogram accumulates
-        # inside the compaction launch, dropping the separate
-        # smaller-child histogram launch (~0.35 ms dispatch floor each,
-        # ~40% of the split loop's kernel count in the round-3 profile)
-        # and its whole h_tier cond chain.  Gated on the hist block
-        # fitting comfortably in VMEM next to the routing matrices.
+        # mega split-step kernel (ops/record.py split_step_window):
+        # compaction + LEFT-child histogram + both searches + in-place
+        # buffer updates in ONE launch, dropping the separate
+        # smaller-child histogram launch and its whole h_tier cond
+        # chain.  Gated on the hist block fitting comfortably in VMEM
+        # next to the routing matrices.
         _Bp = _round_up(num_bins, 128)
         _Fp = _round_up(F, _FGROUP)
         # LGBM_TPU_FUSE_HIST=0 is the A/B escape hatch (read at import

@@ -344,7 +344,21 @@ class GBDT:
         """Histogram implementation for depthwise growth (config.hist_impl):
         the leaf-sorted MXU matmul kernel on TPU, segment_sum elsewhere.
         f64 reference-parity accumulation keeps segment_sum — the Pallas
-        kernels are f32 (same gate as _leafwise_hist_fn)."""
+        kernels are f32 (same gate as _leafwise_hist_fn).
+
+        Sparse-ingested datasets below Config.sparse_hist_density use
+        the O(nnz) CSR histogram (ops/sparse_hist.py) instead of any
+        O(n*F) dense pass — the reference's OrderedSparseBin role
+        (ordered_sparse_bin.hpp:79-92)."""
+        ds = self.train_set
+        if (ds is not None and ds.is_sparse
+                and self.config.hist_dtype != "float64"):
+            nnz = ds.X_bin.nnz
+            density = nnz / max(1, ds.num_data * ds.num_features)
+            if density <= self.config.sparse_hist_density:
+                from ..ops.sparse_hist import make_sparse_hist_fn
+
+                return make_sparse_hist_fn(ds.X_bin, self._num_bins)
         if self._use_pallas_hist():
             from ..ops.pallas_histogram import make_sorted_hist_fn
 

@@ -382,7 +382,12 @@ def partition_hist_window(
     loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
     roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
 
+    z = jnp.int32(0)
+    # 8-wide, SAME layout as split_step_window's scal_i: _hist_tile_body
+    # reads (f, thr, is_cat, pcnt) at indices 4-7 (review r4 caught a
+    # 4-wide pack here silently reading out of bounds)
     scal = jnp.stack([
+        z, z, z, z,
         jnp.maximum(f, 0).astype(jnp.int32),
         thr.astype(jnp.int32),
         is_cat.astype(jnp.int32),
@@ -434,6 +439,60 @@ def partition_hist_window(
         out = out.at[lr].set(keep[0] * leafvals + (1 - keep[0]) * out[lr])
     rec2 = jax.lax.dynamic_update_slice(rec, out, (0, begin))
     return rec2, nleft, hist[0]
+
+
+def _write_window_kernel(scal_ref, win_ref, rec_in_ref, rec_out_ref, sem):
+    """Stream one [W, T] tile of the merged window back into the record
+    at [begin + i*T, ...) via async DMA.  The record is an ALIASED
+    input/output in ANY memory space: XLA then threads it through the
+    tier-cond chain as a custom-call alias — the round-4 profile showed
+    the plain dynamic-update-slice write-back forcing a full-record copy
+    (~95 ms/tree at 1M) at the conditional boundary, while the (aliased)
+    histogram buffer threaded copy-free."""
+    i = pl.program_id(0)
+    begin = scal_ref[0]
+    dma = pltpu.make_async_copy(
+        win_ref,
+        rec_out_ref.at[:, pl.ds(begin + i * TILE, TILE)],
+        sem,
+    )
+    dma.start()
+    dma.wait()
+
+
+# opt-in until validated on real hardware: the DMA dst offset
+# begin + i*TILE is NOT 128-lane aligned (begin is a cumulative nleft),
+# and Mosaic's unaligned-DMA behavior must be proven on chip first
+# (tools/tpu_parity_check.py check_writeback covers unaligned begins)
+ALIASED_WRITEBACK = _os.environ.get("LGBM_TPU_ALIASED_WRITEBACK", "0") != "0"
+
+
+def write_window(rec, out_win, begin, cap: int, interpret: bool = False):
+    """rec[:, begin:begin+cap] = out_win, with rec aliased in place.
+    Interpret mode (CPU tests) uses the semantically identical
+    dynamic-update-slice — the interpreter maps aliased outputs onto
+    read-only numpy views that a DMA write cannot target."""
+    if interpret or not ALIASED_WRITEBACK:
+        return jax.lax.dynamic_update_slice(rec, out_win, (0, begin))
+    W = rec.shape[0]
+    nt = cap // TILE
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((W, TILE), lambda i, s: (0, i)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        _write_window_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(rec.shape, rec.dtype),
+        input_output_aliases={2: 0},  # rec (after the prefetch arg)
+        interpret=interpret,
+    )(jnp.asarray(begin, jnp.int32)[None], out_win, rec)
 
 
 def _split_step_kernel(
@@ -611,7 +670,9 @@ def split_step_window(
     leafvals = (is_left[0] * parent_slot.astype(jnp.int32)
                 + (1 - is_left[0]) * new_slot.astype(jnp.int32))
     out = out.at[lr].set(keep[0] * leafvals + (1 - keep[0]) * out[lr])
-    rec2 = jax.lax.dynamic_update_slice(rec, out, (0, begin))
+    # aliased DMA write-back instead of dynamic-update-slice: keeps the
+    # record threading the tier-cond chain copy-free (see write_window)
+    rec2 = write_window(rec, out, begin, cap, interpret=interpret)
     return hists_new, rec2, nleft, res
 
 

@@ -1,0 +1,88 @@
+"""Sparse O(nnz) histogram crossover measurement (VERDICT r3 item 8).
+
+Times the dense level histogram (histogram_by_leaf / Pallas sorted
+kernel) against the CSR O(nnz) path (ops/sparse_hist.py) at fixed
+n x F and varying density, and prints the crossover — the density below
+which news20-class data should take the sparse path.  The default
+Config.sparse_hist_density gate is chosen from this measurement.
+
+    python tools/bench_sparse.py            # real chip if live
+    BENCH_PLATFORM=cpu python tools/bench_sparse.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+N = int(float(os.environ.get("SPARSE_ROWS", 200_000)))
+F = int(os.environ.get("SPARSE_FEATS", 512))
+B = int(os.environ.get("SPARSE_BINS", 32))
+L = 16
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import histogram_by_leaf
+    from lightgbm_tpu.ops.sparse_hist import sparse_histogram_by_leaf
+
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} n={N} F={F} B={B} L={L}", file=sys.stderr)
+    rng = np.random.RandomState(0)
+    leaf_id = jnp.asarray(rng.randint(0, L, N).astype(np.int32))
+    g = jnp.asarray(rng.randn(N).astype(np.float32))
+    h = jnp.asarray((rng.rand(N) + 0.5).astype(np.float32))
+    m = jnp.ones(N, jnp.float32)
+
+    def timeit(fn, *args, reps=5):
+        fn(*args).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    rows = []
+    for density in (0.005, 0.01, 0.02, 0.05, 0.1, 0.2):
+        nnz = int(N * F * density)
+        erow = jnp.asarray(
+            np.sort(rng.randint(0, N, nnz)).astype(np.int32))
+        ecol = jnp.asarray(rng.randint(0, F, nnz).astype(np.int32))
+        ebin = jnp.asarray(rng.randint(1, B, nnz).astype(np.uint8))
+        dbins = jnp.zeros(F, jnp.int32)
+        # dense matrix holding the same data (default bin 0 elsewhere)
+        dense = np.zeros((F, N), np.uint8)
+        dense[np.asarray(ecol), np.asarray(erow)] = np.asarray(ebin)
+        bins_T = jnp.asarray(dense)
+
+        t_sparse = timeit(
+            lambda: sparse_histogram_by_leaf(
+                erow, ecol, ebin, dbins, leaf_id, g, h, m,
+                num_leaves=L, num_features=F, num_bins=B))
+        t_dense = timeit(
+            lambda: histogram_by_leaf(
+                bins_T, leaf_id, g, h, m, num_bins=B, num_leaves=L))
+        rows.append({"density": density, "sparse_ms": round(t_sparse * 1e3, 2),
+                     "dense_ms": round(t_dense * 1e3, 2),
+                     "sparse_wins": bool(t_sparse < t_dense)})
+        print(rows[-1], file=sys.stderr)
+
+    cross = next((r["density"] for r in rows if not r["sparse_wins"]), None)
+    print(json.dumps({"platform": platform, "rows": rows,
+                      "crossover_density": cross}))
+
+
+if __name__ == "__main__":
+    main()
