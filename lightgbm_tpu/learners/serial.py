@@ -49,6 +49,11 @@ import jax.numpy as jnp
 # env flip would silently not apply to already-traced shapes (ADVICE r3).
 _KERN_ENV = _os.environ.get("LGBM_TPU_SEARCH_KERNEL", "pallas") != "jnp"
 _FUSE_HIST_ENV = _os.environ.get("LGBM_TPU_FUSE_HIST", "1") != "0"
+# direct in-kernel placement (ops/record.py place_runs): replaces the
+# XLA scan-of-DUS + roll/merge chain and the full-record tier-cond copy.
+# Chip-validated by tools/tpu_parity_check.py (1M: 0.473 -> 0.399
+# s/tree); interpret mode uses the bit-identical XLA fallback.
+_DIRECT_PLACE_ENV = _os.environ.get("LGBM_TPU_DIRECT_PLACE", "1") != "0"
 
 from ..models.tree import Tree, empty_tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
@@ -424,8 +429,8 @@ def grow_tree(
         from ..ops.record import (
             TILE as _REC_TILE,
             bins_per_word, build_record, extract_feature, num_words,
-            partition_window, rec_height, split_step_window,
-            unpack_window,
+            partition_window, place_runs, rec_height,
+            split_step_window, unpack_window,
         )
 
         k_pack = bins_per_word(bins_T.dtype)
@@ -446,7 +451,13 @@ def grow_tree(
         _Fp = _round_up(F, _FGROUP)
         # LGBM_TPU_FUSE_HIST=0 is the A/B escape hatch (read at import
         # like the other kernel knobs — see _KERN_ENV)
-        fuse_hist = _FUSE_HIST_ENV and _Fp * _Bp * 16 <= (1 << 21)
+        # tight VMEM gate: at Fp=248/Bp=256 (a one-hot categorical
+        # bench shape) the mega kernel's scoped VMEM measured 16.16M
+        # against the 16M limit — the hist block must stay well clear
+        # of the ~12MB routing matrices + search temporaries, so cap it
+        # at 512KB (Fp*Bp*16B); wider shapes take the 2-kernel path
+        fuse_hist = _FUSE_HIST_ENV and _Fp * _Bp * 16 <= (1 << 19)
+        direct_place = fuse_hist and _DIRECT_PLACE_ENV
         if fuse_hist:
             # constant per tree: the search kernel's [Fp, 4] meta block
             _mega_meta = _search_pack_meta(
@@ -658,12 +669,22 @@ def grow_tree(
             def _mega_rec(cap):
                 fv = extract_feature(state.order, f, begin, cap, k_pack)
                 go = _go_i32(fv, thr, is_cat)
-                return split_step_window(
-                    state.hists, state.order, go, begin, pcnt, do_split,
-                    f, thr, is_cat, best_leaf, new_leaf, scal_f,
-                    _mega_meta, F=F, cap=cap,
-                    k=k_pack, fgroup=_FGROUP, interpret=_interp,
+                out = split_step_window(
+                    state.hists, state.order, go, begin, pcnt,
+                    do_split, f, thr, is_cat, best_leaf, new_leaf,
+                    scal_f, _mega_meta, F=F, cap=cap, k=k_pack,
+                    fgroup=_FGROUP, return_comp=direct_place,
+                    interpret=_interp,
                 )
+                if not direct_place:
+                    return out
+                mh, comp, nl, res = out
+                rec2 = place_runs(
+                    state.order, comp, go, begin, pcnt, nl, do_split,
+                    best_leaf, new_leaf, cap=cap, leaf_row=_leaf_row,
+                    interpret=_interp,
+                )
+                return mh, rec2, nl, res
 
             mega_hists, order, nleft, mega_res = _tier_chain(
                 p_tiers, state.gate_cnt[best_leaf], _mega_rec

@@ -415,6 +415,24 @@ def partition_hist_window(
         interpret=interpret,
     )(scal, win, gov.reshape(cap, 1))
 
+    rec2 = _xla_place(
+        rec, win, comp, loff, roff, nleft, iota, valid, do_split, begin,
+        cap, leaf_row=num_words(F, k) + 4 if left_leaf is not None else -1,
+        left_leaf=left_leaf, right_leaf=right_leaf)
+    return rec2, nleft, hist[0]
+
+
+
+def _xla_place(rec, win, comp, loff, roff, nleft, iota, valid, do_split,
+               begin, cap, leaf_row=-1, left_leaf=None, right_leaf=None):
+    """Reference XLA placement: scan-of-DUS run packing + roll/merge +
+    optional leaf-id stamping + window write-back.  Shared by
+    partition_window, partition_hist_window, split_step_window, and
+    place_runs' interpret fallback — the hardware path (ops.record
+    place_runs kernel) is parity-checked against THIS implementation."""
+    T = TILE
+    W = rec.shape[0]
+
     def place(carry, x):
         lbuf, rbuf = carry
         c, lo, ro = x
@@ -430,69 +448,102 @@ def partition_hist_window(
     merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
     keep = (valid * do_split.astype(jnp.int32))[None, :]
     out = merged * keep + win * (1 - keep)
-    if left_leaf is not None:
-        # stamp child leaf ids over the parent's (kept) range: after the
-        # roll, [0, nleft) is the left child and [nleft, pcnt) the right
-        lr = num_words(F, k) + 4
+    if leaf_row >= 0 and left_leaf is not None:
+        # after the roll, [0, nleft) is the left child, [nleft, pcnt)
+        # the right — stamp the child ids over the kept range
         leafvals = (is_left[0] * left_leaf.astype(jnp.int32)
                     + (1 - is_left[0]) * right_leaf.astype(jnp.int32))
-        out = out.at[lr].set(keep[0] * leafvals + (1 - keep[0]) * out[lr])
-    rec2 = jax.lax.dynamic_update_slice(rec, out, (0, begin))
-    return rec2, nleft, hist[0]
+        out = out.at[leaf_row].set(
+            keep[0] * leafvals + (1 - keep[0]) * out[leaf_row])
+    return jax.lax.dynamic_update_slice(rec, out, (0, begin))
 
 
-def _write_window_kernel(scal_ref, win_ref, rec_in_ref, rec_out_ref, sem):
-    """Stream one [W, T] tile of the merged window back into the record
-    at [begin + i*T, ...) via async DMA.  The record is an ALIASED
-    input/output in ANY memory space: XLA then threads it through the
-    tier-cond chain as a custom-call alias — the round-4 profile showed
-    the plain dynamic-update-slice write-back forcing a full-record copy
-    (~95 ms/tree at 1M) at the conditional boundary, while the (aliased)
-    histogram buffer threaded copy-free."""
+def _write_window_kernel(scal_ref, prev_ref, cur_ref, rec_in_ref,
+                         rec_out_ref, *, nt):
+    """One grid step rewrites ONE T-lane block of the record that the
+    window [begin, begin+cap) touches: the window content is rotated
+    into block alignment (pltpu.roll by begin%T, dynamic) and merged
+    with the block's OLD content outside the window bounds.  Everything
+    uses supported constructs — dynamic BLOCK index maps, roll, and
+    arithmetic selects; no manual DMA (Mosaic rejects dynamically
+    lane-sliced HBM DMAs outright, aligned or not — probed on chip).
+
+    scal [3]: (begin // T, begin % T, last content block — the r == 0
+    surplus step clamps onto it, see write_window)
+    prev/cur: window blocks i-1 and i (the rotated block straddles two)
+    rec_in/rec_out: the SAME aliased record block at begin//T + i
+    """
+    T = TILE
     i = pl.program_id(0)
-    begin = scal_ref[0]
-    dma = pltpu.make_async_copy(
-        win_ref,
-        rec_out_ref.at[:, pl.ds(begin + i * TILE, TILE)],
-        sem,
-    )
-    dma.start()
-    dma.wait()
+    r = scal_ref[1]
+
+    # A no-op grid step happens only when r == 0 (the window spans
+    # exactly nt blocks and step nt is surplus).  Its block index is
+    # CLAMPED onto the last content block; writing there would clobber
+    # the previous step's output with stale input (the aliased input
+    # block is not re-fetched on a same-index revisit), so skip.
+    @pl.when(i * T - r < nt * T)
+    def _():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        # source index into the window for lane t: i*T + t - r; valid
+        # (= inside the window) iff 0 <= idx < cap == nt*T
+        idx = i * T + lane - r
+        new_mask = ((idx >= 0) & (idx < nt * T)).astype(jnp.int32)
+        both = jnp.concatenate([prev_ref[...], cur_ref[...]], axis=1)
+        shifted = pltpu.roll(both, r, axis=1)[:, T:]
+        old = rec_in_ref[...]
+        rec_out_ref[...] = shifted * new_mask + old * (1 - new_mask)
 
 
-# opt-in until validated on real hardware: the DMA dst offset
-# begin + i*TILE is NOT 128-lane aligned (begin is a cumulative nleft),
-# and Mosaic's unaligned-DMA behavior must be proven on chip first
-# (tools/tpu_parity_check.py check_writeback covers unaligned begins)
-ALIASED_WRITEBACK = _os.environ.get("LGBM_TPU_ALIASED_WRITEBACK", "0") != "0"
+# opt-in escape hatch (on by default once chip-validated by
+# tools/tpu_parity_check.py check_writeback)
+ALIASED_WRITEBACK = _os.environ.get("LGBM_TPU_ALIASED_WRITEBACK", "1") != "0"
 
 
 def write_window(rec, out_win, begin, cap: int, interpret: bool = False):
-    """rec[:, begin:begin+cap] = out_win, with rec aliased in place.
+    """rec[:, begin:begin+cap] = out_win, with rec aliased in place so
+    the record threads tier-cond boundaries copy-free (the round-4
+    profile showed the plain dynamic-update-slice write-back forcing a
+    full-record copy, ~95 ms/tree at 1M, while the aliased histogram
+    buffer threaded the same conds copy-free).
+
     Interpret mode (CPU tests) uses the semantically identical
     dynamic-update-slice — the interpreter maps aliased outputs onto
-    read-only numpy views that a DMA write cannot target."""
+    read-only numpy views."""
     if interpret or not ALIASED_WRITEBACK:
         return jax.lax.dynamic_update_slice(rec, out_win, (0, begin))
-    W = rec.shape[0]
-    nt = cap // TILE
+    W, n_pad = rec.shape
+    T = TILE
+    nt = cap // T
+    nb = nt + 1  # the rotated window straddles up to nt+1 blocks
+    scal = jnp.stack([
+        (begin // T).astype(jnp.int32),
+        (begin % T).astype(jnp.int32),
+        # last CONTENT block: the surplus step (r == 0 only) clamps
+        # here, revisiting a written block (and skipping its write)
+        # instead of touching a pristine one
+        ((begin + cap - 1) // T).astype(jnp.int32),
+    ])
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nt,),
+        grid=(nb,),
         in_specs=[
-            pl.BlockSpec((W, TILE), lambda i, s: (0, i)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((W, T), lambda i, s: (0, jnp.maximum(i - 1, 0))),
+            pl.BlockSpec((W, T), lambda i, s: (0, jnp.minimum(i, nt - 1))),
+            pl.BlockSpec(
+                (W, T),
+                lambda i, s: (0, jnp.minimum(s[0] + i, s[2]))),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        out_specs=pl.BlockSpec(
+            (W, T), lambda i, s: (0, jnp.minimum(s[0] + i, s[2]))),
     )
     return pl.pallas_call(
-        _write_window_kernel,
+        functools.partial(_write_window_kernel, nt=nt),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(rec.shape, rec.dtype),
-        input_output_aliases={2: 0},  # rec (after the prefetch arg)
+        input_output_aliases={3: 0},  # rec (incl. the prefetch arg)
         interpret=interpret,
-    )(jnp.asarray(begin, jnp.int32)[None], out_win, rec)
+    )(scal, out_win, out_win, rec)
 
 
 def _split_step_kernel(
@@ -566,9 +617,165 @@ def _split_step_kernel(
         hists_out_ref[0] = jnp.where(do_split, hacc_ref[...], hrow_ref[0])
 
 
+def _place_kernel(sp_ref, comp_ref, rec_in_ref, rec_out_ref, *,
+                  W, nt, leaf_row):
+    """Placement-only kernel: stream the compacted left/right runs into
+    the ALIASED record at their (arbitrary, unaligned) destinations —
+    replacing the XLA scan-of-DUS + roll/merge chain AND the full-record
+    copy its dynamic-update-slice forced at the tier-cond boundary.
+
+    Step table sp [4*nt, 8] i32 (see _place_table): per step one run
+    half lands in one T-lane rec block; block indices are monotone, so
+    each block is flushed exactly once after its last write.  On an
+    index advance the merge base is the freshly fetched block; on a
+    revisit it is the still-resident out block.  Child leaf ids are
+    stamped into the record's leaf-id row as part of the same write.
+    """
+    T = TILE
+    i = pl.program_id(0)
+    en = sp_ref[6, i] > 0
+
+    # NOTE the table is stored TRANSPOSED [8, 4nt]: a [4nt, 8] SMEM
+    # prefetch array pads its minor dim to 128 lanes (16x the bytes) and
+    # blew the 1MB SMEM budget at large nt
+    def _merge(base):
+        half = sp_ref[1, i] & 1
+        comp = comp_ref[0]  # [W, 2T]
+        content = comp[:, :T] * (1 - half) + comp[:, T:] * half
+        rolled = pltpu.roll(content, sp_ref[2, i], axis=1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        mask = ((lane >= sp_ref[3, i]) & (lane < sp_ref[4, i])
+                ).astype(jnp.int32)
+        rowsel = (jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+                  == leaf_row).astype(jnp.int32)
+        stamped = rowsel * sp_ref[7, i] + (1 - rowsel) * rolled
+        return mask * stamped + (1 - mask) * base
+
+    @pl.when(en & (sp_ref[5, i] > 0))
+    def _():
+        rec_out_ref[...] = _merge(rec_in_ref[...])
+
+    @pl.when(en & (sp_ref[5, i] == 0))
+    def _():
+        rec_out_ref[...] = _merge(rec_out_ref[...])
+
+    @pl.when((i == 0) & jnp.logical_not(en))
+    def _():
+        # a fully disabled table (no-op split) must still write the
+        # parked block once or the grid-end flush emits garbage
+        rec_out_ref[...] = rec_in_ref[...]
+
+
+def _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
+                 left_leaf, right_leaf, do_split, nt):
+    """[4*nt, 8] i32 placement step table (columns documented on
+    _place_kernel).  Lefts stream to [begin, begin+nleft), rights to
+    [begin+nleft, begin+pcnt); each tile's run may straddle two blocks
+    (lower + upper step).  Block indices are forward-filled monotone."""
+    T = TILE
+
+    def run_rows(gbase, counts, offs, half_flag, leaf_val):
+        g = gbase + offs
+        b = g // T
+        s_ = g % T
+        end = s_ + counts
+        spill = end - T
+        has_lo = (counts > 0).astype(jnp.int32)
+        has_up = (spill > 0).astype(jnp.int32)
+        j2 = jnp.arange(nt, dtype=jnp.int32) * 2 + half_flag
+        zeros = jnp.zeros_like(b)
+        lower = jnp.stack([
+            b, j2, s_, s_, jnp.minimum(end, T), zeros, has_lo,
+            jnp.full_like(b, leaf_val)], axis=1)
+        upper = jnp.stack([
+            b + has_up, j2, s_, zeros, jnp.maximum(spill, 0), zeros,
+            has_up, jnp.full_like(b, leaf_val)], axis=1)
+        return jnp.stack([lower, upper], axis=1).reshape(2 * nt, 8)
+
+    rowsL = run_rows(begin, cl, loff, 0, left_leaf)
+    rowsR = run_rows(begin + nleft, cr, roff, 1, right_leaf)
+    rows = jnp.concatenate([rowsL, rowsR])
+    enable = rows[:, 6] * do_split.astype(jnp.int32)
+    park = (begin // T).astype(jnp.int32)
+    idx_seq = jnp.where(enable > 0, rows[:, 0], -1)
+    idx_ff = jax.lax.cummax(
+        jnp.concatenate([park[None], idx_seq])[None], axis=1)[0][1:]
+    adv = (jnp.concatenate([park[None], idx_ff])[:-1] != idx_ff
+           ).astype(jnp.int32)
+    # the FIRST enabled row merges from the freshly fetched block even
+    # at the park index (the out window there was never written)
+    first_en = ((jnp.cumsum(enable) == 1) & (enable > 0)).astype(jnp.int32)
+    adv = jnp.maximum(adv, first_en)
+    rows = rows.at[:, 0].set(idx_ff)
+    rows = rows.at[:, 5].set(adv)
+    rows = rows.at[:, 6].set(enable)
+    return rows.T  # [8, 4nt]: SMEM pads the minor dim to 128 lanes
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap", "leaf_row", "interpret"),
+    donate_argnums=(0,),
+)
+def place_runs(
+    rec,  # [W, n_pad] i32 — DONATED, aliased in place
+    comp,  # [nt, W, 2T] i32 — the split kernel's compacted tiles
+    go,  # [cap] i32 (same decision column the split kernel consumed)
+    begin, pcnt, nleft, do_split,
+    left_leaf, right_leaf,
+    cap: int,
+    leaf_row: int,
+    interpret: bool = False,
+):
+    """Scatter the compacted runs into the record in ONE aliased launch.
+    Interpret mode falls back to the (bit-identical, slower) XLA
+    scan-of-DUS placement so CPU tests stay meaningful; hardware parity
+    of the kernel path is pinned by tools/tpu_parity_check.py."""
+    W, n_pad = rec.shape
+    T = TILE
+    nt = cap // T
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = (iota < pcnt).astype(jnp.int32)
+    gov = jnp.asarray(go).astype(jnp.int32) * valid
+    kt = gov.reshape(nt, T)
+    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
+    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
+    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
+    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+
+    if interpret:
+        # reference placement (the XLA path the kernel replaces)
+        win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
+        return _xla_place(
+            rec, win, comp, loff, roff, nleft, iota, valid, do_split,
+            begin, cap, leaf_row=leaf_row, left_leaf=left_leaf,
+            right_leaf=right_leaf)
+
+    sp = _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
+                      left_leaf, right_leaf, do_split, nt)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(4 * nt,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, W, 2 * T),
+                lambda i, sp: (sp[1, i] >> 1, 0, 0)),
+            pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
+        ],
+        out_specs=pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
+    )
+    return pl.pallas_call(
+        functools.partial(_place_kernel, W=W, nt=nt, leaf_row=leaf_row),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, n_pad), jnp.int32),
+        input_output_aliases={2: 0},  # rec (after the prefetch arg)
+        interpret=interpret,
+    )(sp, comp, rec)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("F", "cap", "k", "fgroup", "interpret"),
+    static_argnames=("F", "cap", "k", "fgroup", "return_comp",
+                     "interpret"),
     donate_argnums=(0,),
 )
 def split_step_window(
@@ -582,6 +789,7 @@ def split_step_window(
     meta,  # [Fp, 4] — pallas_search._pack_meta
     F: int, cap: int, k: int,
     fgroup: int = 8,
+    return_comp: bool = False,
     interpret: bool = False,
 ):
     """One-launch split step over window [begin, begin+cap): compaction
@@ -589,8 +797,10 @@ def split_step_window(
     hists-row updates.  Returns (hists', rec', nleft, res[2, 16]).
 
     The child leaf ids are stamped into the record's leaf-id row (see
-    rec_height); placement of the compacted runs stays in the XLA DUS
-    scan (Mosaic DMA lane alignment).
+    rec_height).  With ``return_comp`` the XLA placement (scan-of-DUS +
+    roll/merge) is SKIPPED and the raw compacted tiles come back as
+    (hists', comp[nt, W, 2T], nleft, res) for ops.record.place_runs —
+    the aliased placement kernel that replaces that whole chain.
     """
     W = rec.shape[0]
     T = TILE
@@ -651,28 +861,13 @@ def split_step_window(
         interpret=interpret,
     )(scal_i, scal_f, win, gov.reshape(cap, 1), hists, meta)
 
-    def place(carry, x):
-        lbuf, rbuf = carry
-        c, lo, ro = x
-        lbuf = jax.lax.dynamic_update_slice(lbuf, c[:, :T], (0, lo))
-        rbuf = jax.lax.dynamic_update_slice(rbuf, c[:, T:], (0, ro))
-        return (lbuf, rbuf), None
+    if return_comp:
+        return hists_new, comp, nleft, res
 
-    buf0 = jnp.zeros((W, cap + T), jnp.int32)
-    (lbuf, rbuf), _ = jax.lax.scan(place, (buf0, buf0), (comp, loff, roff))
-
-    rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
-    is_left = (iota < nleft).astype(jnp.int32)[None, :]
-    merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
-    keep = (valid * do_split.astype(jnp.int32))[None, :]
-    out = merged * keep + win * (1 - keep)
-    lr = num_words(F, k) + 4
-    leafvals = (is_left[0] * parent_slot.astype(jnp.int32)
-                + (1 - is_left[0]) * new_slot.astype(jnp.int32))
-    out = out.at[lr].set(keep[0] * leafvals + (1 - keep[0]) * out[lr])
-    # aliased DMA write-back instead of dynamic-update-slice: keeps the
-    # record threading the tier-cond chain copy-free (see write_window)
-    rec2 = write_window(rec, out, begin, cap, interpret=interpret)
+    rec2 = _xla_place(
+        rec, win, comp, loff, roff, nleft, iota, valid, do_split, begin,
+        cap, leaf_row=num_words(F, k) + 4,
+        left_leaf=parent_slot, right_leaf=new_slot)
     return hists_new, rec2, nleft, res
 
 
@@ -735,32 +930,8 @@ def partition_window(
         interpret=interpret,
     )(win, gov.reshape(cap, 1))
 
-    # in-order placement: sequential DUS writes let each tile's garbage
-    # tail be overwritten by the next tile's run
-    def place(carry, x):
-        lbuf, rbuf = carry
-        c, lo, ro = x
-        lbuf = jax.lax.dynamic_update_slice(lbuf, c[:, :T], (0, lo))
-        rbuf = jax.lax.dynamic_update_slice(rbuf, c[:, T:], (0, ro))
-        return (lbuf, rbuf), None
-
-    buf0 = jnp.zeros((W, cap + T), jnp.int32)
-    (lbuf, rbuf), _ = jax.lax.scan(
-        place, (buf0, buf0), (comp, loff, roff))
-
-    # merge: [0, nleft) from the left runs, [nleft, pcnt) from the right
-    # runs shifted to start at nleft (dynamic roll = two contiguous
-    # slices), everything else keeps its original value.  Selects are
-    # ARITHMETIC on i32 masks: [cap, 1]-shaped pred tensors bounce
-    # between bit layouts on this stack (~100 ms/tree of copies)
-    rolled = jnp.roll(rbuf, nleft, axis=1)[:, :cap]
-    is_left = (iota < nleft).astype(jnp.int32)[None, :]
-    merged = lbuf[:, :cap] * is_left + rolled * (1 - is_left)
-    keep = (valid * do_split.astype(jnp.int32))[None, :]
-    out = merged * keep + win * (1 - keep)
-    if leaf_row >= 0 and left_leaf is not None:
-        leafvals = (is_left[0] * left_leaf.astype(jnp.int32)
-                    + (1 - is_left[0]) * right_leaf.astype(jnp.int32))
-        out = out.at[leaf_row].set(
-            keep[0] * leafvals + (1 - keep[0]) * out[leaf_row])
-    return jax.lax.dynamic_update_slice(rec, out, (0, begin)), nleft
+    rec2 = _xla_place(
+        rec, win, comp, loff, roff, nleft, iota, valid, do_split, begin,
+        cap, leaf_row=leaf_row, left_leaf=left_leaf,
+        right_leaf=right_leaf)
+    return rec2, nleft

@@ -211,6 +211,81 @@ def check_writeback(rng) -> bool:
     return ok
 
 
+def check_place(rng) -> bool:
+    """place_runs (aliased placement kernel) vs the XLA scan-of-DUS
+    reference it replaces — the hardware-only path (interpret falls
+    back to the reference)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.record import (
+        TILE, bins_per_word, build_record, extract_feature, num_words,
+        partition_window, place_runs, round_up, split_step_window)
+    from lightgbm_tpu.ops.pallas_search import _pack_meta, _pack_scal
+
+    ok = True
+    for trial, (F, n, num_bins, begin_off, frac) in enumerate((
+            (9, 5000, 33, 0, 0.5),
+            (9, 5000, 33, 777, 0.2),   # unaligned begin, unbalanced
+            (9, 5000, 33, 1291, 0.97),  # nearly-all-left
+            (5, 2000, 16, 300, 0.0),   # all-right
+    )):
+        bins = rng.randint(0, num_bins, (F, n)).astype(np.uint8)
+        g = rng.randn(n).astype(np.float32)
+        h = (rng.rand(n) + 0.5).astype(np.float32)
+        bag = np.ones(n, np.float32)
+        k = bins_per_word(jnp.uint8)
+        total = round_up(n + begin_off, TILE) + TILE
+        rec = build_record(
+            jnp.asarray(np.pad(bins, ((0, 0), (begin_off, 0)))),
+            jnp.asarray(np.pad(g, (begin_off, 0))),
+            jnp.asarray(np.pad(h, (begin_off, 0))),
+            jnp.asarray(np.pad(bag, (begin_off, 0))), total)
+        cap = round_up(n, TILE)
+        thr = int(num_bins * frac)
+        f = 2
+        begin = jnp.int32(begin_off)
+        fv = extract_feature(rec, jnp.int32(f), begin, cap, k)
+        go = (fv <= thr).astype(jnp.int32)
+        lr = num_words(F, k) + 4
+
+        # reference: partition_window (scan-of-DUS) with leaf stamping
+        recA, nlA = partition_window(
+            rec, go, begin, jnp.int32(n), jnp.bool_(True), cap,
+            left_leaf=jnp.int32(3), right_leaf=jnp.int32(5),
+            leaf_row=lr)
+        # kernel path: compacted tiles -> place_runs
+        Fp, Bp = round_up(F, 8), round_up(num_bins, 128)
+        # slots 3 and 5 are written by the kernel's hists index maps —
+        # allocate past them (Pallas does not bounds-check index maps)
+        hists = jnp.zeros((7, Fp, 4, Bp), jnp.float32)
+        meta = _pack_meta(jnp.ones(F, bool),
+                          jnp.full(F, num_bins, jnp.int32),
+                          jnp.zeros(F, bool), Fp)
+        scal = _pack_scal(*[jnp.float32(x) for x in
+                            (1., 0., 1., 9., 0., 1., 9., 1., 1e-3,
+                             0., 0., 0.)])
+        _, comp, nlB, _ = split_step_window(
+            hists, rec, go, begin, jnp.int32(n), jnp.bool_(True),
+            jnp.int32(f), jnp.int32(thr), jnp.bool_(False),
+            jnp.int32(3), jnp.int32(5), scal, meta, F=F, cap=cap, k=k,
+            return_comp=True)
+        recB = place_runs(
+            jnp.array(rec), comp, go, begin, jnp.int32(n), nlB,
+            jnp.bool_(True), jnp.int32(3), jnp.int32(5), cap=cap,
+            leaf_row=lr)
+        if int(nlA) != int(nlB):
+            log(f"  place trial {trial}: nleft {int(nlA)} vs {int(nlB)}")
+            ok = False
+        ra, rb = np.asarray(recA), np.asarray(recB)
+        if not np.array_equal(ra, rb):
+            bad = [r for r in range(ra.shape[0])
+                   if not np.array_equal(ra[r], rb[r])]
+            log(f"  place trial {trial}: record rows differ {bad}")
+            ok = False
+    log(f"place parity: {'OK' if ok else 'FAIL'}")
+    return ok
+
+
 def main() -> None:
     import jax
 
@@ -221,7 +296,8 @@ def main() -> None:
             "run it in a live-chip window")
         sys.exit(2)
     rng = np.random.RandomState(0)
-    results = [check_writeback(rng), check_search(rng), check_split(rng)]
+    results = [check_writeback(rng), check_search(rng), check_split(rng),
+               check_place(rng)]
     sys.exit(0 if all(results) else 1)
 
 
