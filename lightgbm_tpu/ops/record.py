@@ -633,11 +633,12 @@ def _place_kernel(sp_ref, comp_ref, rec_in_ref, rec_out_ref, *,
     """
     T = TILE
     i = pl.program_id(0)
+    # the table is stored TRANSPOSED [8, steps]: a [steps, 8] SMEM
+    # prefetch array pads its minor dim to 128 lanes (16x the bytes);
+    # huge tiers additionally CHUNK the table across multiple launches
+    # to stay inside the 1MB SMEM budget (see place_runs)
     en = sp_ref[6, i] > 0
 
-    # NOTE the table is stored TRANSPOSED [8, 4nt]: a [4nt, 8] SMEM
-    # prefetch array pads its minor dim to 128 lanes (16x the bytes) and
-    # blew the 1MB SMEM budget at large nt
     def _merge(base):
         half = sp_ref[1, i] & 1
         comp = comp_ref[0]  # [W, 2T]
@@ -709,7 +710,7 @@ def _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
     rows = rows.at[:, 0].set(idx_ff)
     rows = rows.at[:, 5].set(adv)
     rows = rows.at[:, 6].set(enable)
-    return rows.T  # [8, 4nt]: SMEM pads the minor dim to 128 lanes
+    return rows
 
 
 @functools.partial(
@@ -750,26 +751,45 @@ def place_runs(
             begin, cap, leaf_row=leaf_row, left_leaf=left_leaf,
             right_leaf=right_leaf)
 
-    sp = _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
-                      left_leaf, right_leaf, do_split, nt)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(4 * nt,),
-        in_specs=[
-            pl.BlockSpec(
-                (1, W, 2 * T),
-                lambda i, sp: (sp[1, i] >> 1, 0, 0)),
-            pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
-        ],
-        out_specs=pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
-    )
-    return pl.pallas_call(
-        functools.partial(_place_kernel, W=W, nt=nt, leaf_row=leaf_row),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((W, n_pad), jnp.int32),
-        input_output_aliases={2: 0},  # rec (after the prefetch arg)
-        interpret=interpret,
-    )(sp, comp, rec)
+    rows = _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
+                        left_leaf, right_leaf, do_split, nt)
+    # chunk the step table across launches: a [8, steps] i32 SMEM
+    # prefetch block is 32B/step (SMEM pads the minor dim to 128 lanes
+    # per ROW, hence the transpose), and the 1MB SMEM budget caps one
+    # launch at ~16k steps — the 10M top tier has ~78k
+    CHUNK = 16384
+    total = 4 * nt
+    n_chunks = -(-total // CHUNK)
+    for c in range(n_chunks):
+        lo = c * CHUNK
+        sl = rows[lo: lo + CHUNK]
+        en_c = sl[:, 6]
+        # each launch's first enabled row must merge from the freshly
+        # fetched block: the previous launch's writes are flushed to
+        # HBM at ITS grid end, not resident in this launch's windows
+        first_c = ((jnp.cumsum(en_c) == 1) & (en_c > 0)).astype(jnp.int32)
+        sl = sl.at[:, 5].set(jnp.maximum(sl[:, 5], first_c))
+        steps = sl.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(steps,),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, W, 2 * T),
+                    lambda i, sp: (sp[1, i] >> 1, 0, 0)),
+                pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
+            ],
+            out_specs=pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
+        )
+        rec = pl.pallas_call(
+            functools.partial(
+                _place_kernel, W=W, nt=nt, leaf_row=leaf_row),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((W, n_pad), jnp.int32),
+            input_output_aliases={2: 0},  # rec (incl. the prefetch arg)
+            interpret=interpret,
+        )(sl.T, comp, rec)
+    return rec
 
 
 @functools.partial(
