@@ -618,7 +618,7 @@ def _split_step_kernel(
 
 
 def _place_kernel(sp_ref, comp_ref, rec_in_ref, rec_out_ref, *,
-                  W, nt, leaf_row):
+                  W, leaf_row):
     """Placement-only kernel: stream the compacted left/right runs into
     the ALIASED record at their (arbitrary, unaligned) destinations —
     replacing the XLA scan-of-DUS + roll/merge chain AND the full-record
@@ -703,10 +703,8 @@ def _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
         jnp.concatenate([park[None], idx_seq])[None], axis=1)[0][1:]
     adv = (jnp.concatenate([park[None], idx_ff])[:-1] != idx_ff
            ).astype(jnp.int32)
-    # the FIRST enabled row merges from the freshly fetched block even
-    # at the park index (the out window there was never written)
-    first_en = ((jnp.cumsum(enable) == 1) & (enable > 0)).astype(jnp.int32)
-    adv = jnp.maximum(adv, first_en)
+    # (each launch's first enabled row is forced to adv=1 in place_runs'
+    # chunk loop — chunk 0 covers the park-index case)
     rows = rows.at[:, 0].set(idx_ff)
     rows = rows.at[:, 5].set(adv)
     rows = rows.at[:, 6].set(enable)
@@ -757,7 +755,7 @@ def place_runs(
     # prefetch block is 32B/step (SMEM pads the minor dim to 128 lanes
     # per ROW, hence the transpose), and the 1MB SMEM budget caps one
     # launch at ~16k steps — the 10M top tier has ~78k
-    CHUNK = 16384
+    CHUNK = int(_os.environ.get("LGBM_TPU_PLACE_CHUNK", "16384"))
     total = 4 * nt
     n_chunks = -(-total // CHUNK)
     for c in range(n_chunks):
@@ -782,8 +780,7 @@ def place_runs(
             out_specs=pl.BlockSpec((W, T), lambda i, sp: (0, sp[0, i])),
         )
         rec = pl.pallas_call(
-            functools.partial(
-                _place_kernel, W=W, nt=nt, leaf_row=leaf_row),
+            functools.partial(_place_kernel, W=W, leaf_row=leaf_row),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((W, n_pad), jnp.int32),
             input_output_aliases={2: 0},  # rec (incl. the prefetch arg)

@@ -93,9 +93,14 @@ def main() -> None:
         np.savetxt(csv, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
 
     child_env = {**os.environ, "PYTHONPATH": REPO}
+    # the parent pins via jax.config; the child only sees env.  An
+    # inherited JAX_PLATFORMS=axon fails in subprocesses (the plugin
+    # registers as 'tpu' there) — strip ONLY that value; any other
+    # deliberate parent pin (e.g. cpu) passes through.
     if os.environ.get("PRED_PLATFORM"):
-        # the parent pins via jax.config; the child only sees env
         child_env["JAX_PLATFORMS"] = os.environ["PRED_PLATFORM"]
+    elif child_env.get("JAX_PLATFORMS") == "axon":
+        child_env["JAX_PLATFORMS"] = ""  # auto-pick (tpu)
     t0 = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, "-m", "lightgbm_tpu.cli", "task=predict",

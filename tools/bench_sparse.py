@@ -36,6 +36,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from lightgbm_tpu.ops.histogram import histogram_by_leaf
+    from lightgbm_tpu.ops.pallas_histogram import make_sorted_hist_fn
     from lightgbm_tpu.ops.sparse_hist import sparse_histogram_by_leaf
 
     platform = jax.devices()[0].platform
@@ -71,9 +72,17 @@ def main() -> None:
             lambda: sparse_histogram_by_leaf(
                 erow, ecol, ebin, dbins, leaf_id, g, h, m,
                 num_leaves=L, num_features=F, num_bins=B))
-        t_dense = timeit(
-            lambda: histogram_by_leaf(
-                bins_T, leaf_id, g, h, m, num_bins=B, num_leaves=L))
+        if platform == "tpu":
+            # the production dense path on chip (Pallas sorted kernel);
+            # the jnp segment fallback broadcasts [F, n, 3] and OOMs HBM
+            # at wide-F shapes
+            sorted_fn = make_sorted_hist_fn(B)
+            t_dense = timeit(
+                lambda: sorted_fn(bins_T, leaf_id, g, h, m, L))
+        else:
+            t_dense = timeit(
+                lambda: histogram_by_leaf(
+                    bins_T, leaf_id, g, h, m, num_bins=B, num_leaves=L))
         rows.append({"density": density, "sparse_ms": round(t_sparse * 1e3, 2),
                      "dense_ms": round(t_dense * 1e3, 2),
                      "sparse_wins": bool(t_sparse < t_dense)})

@@ -222,13 +222,19 @@ def check_place(rng) -> bool:
         partition_window, place_runs, round_up, split_step_window)
     from lightgbm_tpu.ops.pallas_search import _pack_meta, _pack_scal
 
+    # the last trial runs with a tiny LGBM_TPU_PLACE_CHUNK so the
+    # multi-launch chunk-boundary path (forced adv=1 per launch) is
+    # pinned at test size — its unique shape forces a fresh trace with
+    # the env value baked in (the knob is read at trace time)
     ok = True
     for trial, (F, n, num_bins, begin_off, frac) in enumerate((
             (9, 5000, 33, 0, 0.5),
             (9, 5000, 33, 777, 0.2),   # unaligned begin, unbalanced
             (9, 5000, 33, 1291, 0.97),  # nearly-all-left
             (5, 2000, 16, 300, 0.0),   # all-right
+            (7, 3000, 17, 133, 0.4),   # multi-chunk placement
     )):
+        os.environ["LGBM_TPU_PLACE_CHUNK"] = "8" if trial == 4 else "16384"
         bins = rng.randint(0, num_bins, (F, n)).astype(np.uint8)
         g = rng.randn(n).astype(np.float32)
         h = (rng.rand(n) + 0.5).astype(np.float32)
@@ -298,6 +304,7 @@ def main() -> None:
     rng = np.random.RandomState(0)
     results = [check_writeback(rng), check_search(rng), check_split(rng),
                check_place(rng)]
+    os.environ.pop("LGBM_TPU_PLACE_CHUNK", None)
     sys.exit(0 if all(results) else 1)
 
 
