@@ -126,9 +126,14 @@ def train(
     booster.finish_lagged_stop()
     # lagged-stop rollback may have popped trees the early-stopping
     # callback already scored; best_iteration must never point past the
-    # surviving model (ADVICE r3: gbdt.py rollback interaction)
+    # surviving model (ADVICE r3: gbdt.py rollback interaction).  When
+    # the clamp fires, the callback-recorded best_score belongs to a
+    # popped tree — drop it so consumers never pair the surviving
+    # iteration with a rolled-back metric (ADVICE r4).
     if booster.best_iteration > booster.current_iteration:
         booster.best_iteration = booster.current_iteration
+        if getattr(booster, "best_score", None):
+            booster.best_score = {}
     if booster.best_iteration <= 0:
         booster.best_iteration = -1
     return booster

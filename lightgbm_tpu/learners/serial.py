@@ -256,7 +256,7 @@ def default_search_fn(
     static_argnames=(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
         "reduce_max_fn", "child_counts_fn", "search2_fn", "hist_pool",
-        "init_hist_fn", "init_search_fn", "hist_fn_raw",
+        "init_hist_fn", "init_search_fn", "hist_fn_raw", "record_mode",
     ),
 )
 def grow_tree(
@@ -282,6 +282,7 @@ def grow_tree(
     init_hist_fn=None,
     init_search_fn=None,
     hist_fn_raw=None,
+    record_mode: bool = False,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -357,6 +358,23 @@ def grow_tree(
     # one launch) — unpooled only: the left child reuses the parent's
     # buffer row
     opt_fused = opt and not (0 < hist_pool < max_leaves)
+    # ``record_mode``: PARALLEL learners (search hooks present) opt into
+    # the leaf-sorted packed-record partition — the round-3/4 fast path
+    # was previously serial-only, leaving every distributed run on the
+    # per-index-gather partition (VERDICT r4 item 1; the reference's
+    # parallel learners inherit the serial hot loop,
+    # parallel_tree_learner.h:46-90).  Histograms of a child's window
+    # still flow through ``hist_fn`` (which reduce-scatters across the
+    # mesh) and searches through the hooks; only the partition and the
+    # contiguous-window child access change.
+    rec_hooks = (
+        record_mode
+        and not opt
+        and grad.dtype == jnp.float32
+        and init_tree is None
+        and not (0 < hist_pool < max_leaves)
+    )
+    rec = opt_fused or rec_hooks
     fuse_hist = False  # set below when the record path qualifies
     if search_fn is None:
         search_fn = default_search_fn
@@ -413,19 +431,13 @@ def grow_tree(
         # every in-loop histogram (children + pooled parent recompute)
         # is built in the raw layout
         hist_fn = hist_fn_raw
-    if opt_fused:
+    if rec:
         # record mode: the loop state carries the leaf-sorted PACKED
         # RECORD [W, n_pad] (ops/record.py) instead of the row
         # permutation — every per-split access becomes a contiguous
         # slice and the partition runs as the MXU block-compaction
         # kernel.  The round-3 profile showed the order-based path's
         # per-index gathers/scatters costing ~0.4 s/tree at 1M rows.
-        from ..ops.pallas_histogram import FGROUP as _FGROUP
-        from ..ops.pallas_search import (
-            _pack_meta as _search_pack_meta,
-            _pack_scal as _search_pack_scal,
-            _unpack as _search_unpack,
-        )
         from ..ops.record import (
             TILE as _REC_TILE,
             bins_per_word, build_record, extract_feature, num_words,
@@ -441,6 +453,13 @@ def grow_tree(
         h_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in h_tiers}))
         p_tiers = tuple(sorted({_round_up(c, _REC_TILE) for c in p_tiers}))
         order_pad = max(p_tiers + h_tiers)
+    if opt_fused:
+        from ..ops.pallas_histogram import FGROUP as _FGROUP
+        from ..ops.pallas_search import (
+            _pack_meta as _search_pack_meta,
+            _pack_scal as _search_pack_scal,
+            _unpack as _search_unpack,
+        )
         # mega split-step kernel (ops/record.py split_step_window):
         # compaction + LEFT-child histogram + both searches + in-place
         # buffer updates in ONE launch, dropping the separate
@@ -586,7 +605,7 @@ def grow_tree(
                 bins_T, grad, hess, bag_mask,
                 _round_up(n, _REC_TILE) + order_pad,
             )
-            if opt_fused
+            if rec
             else jnp.concatenate(
                 [
                     jnp.arange(n, dtype=jnp.int32),
@@ -689,7 +708,7 @@ def grow_tree(
             mega_hists, order, nleft, mega_res = _tier_chain(
                 p_tiers, state.gate_cnt[best_leaf], _mega_rec
             )
-        elif opt_fused:
+        elif rec:
 
             def _part_rec(cap):
                 fv = extract_feature(state.order, f, begin, cap, k_pack)
@@ -697,7 +716,8 @@ def grow_tree(
                 return partition_window(
                     state.order, go, begin, pcnt, do_split, cap,
                     left_leaf=best_leaf, right_leaf=new_leaf,
-                    leaf_row=_leaf_row, interpret=_interp,
+                    leaf_row=_leaf_row, direct=_DIRECT_PLACE_ENV,
+                    interpret=_interp,
                 )
 
             order, nleft = _tier_chain(
@@ -755,10 +775,11 @@ def grow_tree(
             # mega path: histogram, subtract, search AND buffer update
             # all happened inside split_step_window already
             pass
-        elif opt_fused:
+        elif rec:
             # record mode: the child's rows are a CONTIGUOUS slice of
             # the leaf-sorted record — unpack (vector shifts) + kernel,
-            # no indexed access at all
+            # no indexed access at all.  Under hooks, hist_fn carries
+            # the cross-mesh reduce-scatter.
             def _hist_rec(cap):
                 win = jax.lax.dynamic_slice(
                     order, (0, begin_s), (Wrec, cap))
@@ -997,7 +1018,7 @@ def grow_tree(
     # leaf of a position is a searchsorted over the (few) sorted begins,
     # then one unique-index scatter maps positions back to rows.
     tree = state.tree
-    if opt_fused:
+    if rec:
         # record mode: the partition stamped every position's leaf id
         # into the record's leaf-id row — one contiguous read replaces
         # the searchsorted over leaf ranges (~75 ms/tree of

@@ -43,6 +43,21 @@ from .tree import (
     predict_leaf_raw,
 )
 
+# Batch-prediction backend (read ONCE at import, like the kernel knobs):
+# "auto" = the matmul path (ops/predict_matmul.py) on TPU, the
+# vectorized walk elsewhere (the dense path-incidence matmuls would run
+# at scalar speed on the CPU fallback); "1"/"0" force.
+_PREDICT_MM = os.environ.get("LGBM_TPU_PREDICT_MATMUL", "auto")
+# rows per matmul-predict dispatch: bounds the [rows, L]-shaped dense
+# intermediates (~2.5KB/row/tree-step at L=255) well inside HBM
+_ROW_CHUNK = int(os.environ.get("LGBM_TPU_PREDICT_ROW_CHUNK", str(1 << 20)))
+
+
+def _use_matmul_predict() -> bool:
+    if _PREDICT_MM == "auto":
+        return jax.default_backend() == "tpu"
+    return _PREDICT_MM != "0"
+
 
 @functools.partial(jax.jit, donate_argnums=(1,))
 def _post_grow_step(tree, scores, k, leaf_id, rate, bounds_mat, real_feat):
@@ -65,6 +80,9 @@ class GBDT:
         train_set: Optional[BinnedDataset] = None,
         objective: Optional[ObjectiveFunction] = None,
     ):
+        from .. import _enable_persistent_compile_cache
+
+        _enable_persistent_compile_cache()  # lazy, TPU-gated, once
         self.config = config
         self.num_class = int(config.num_class)
         self.learning_rate = float(config.learning_rate)
@@ -606,18 +624,25 @@ class GBDT:
         return np.asarray(scores)
 
     # ---------------------------------------------------------------- predict
-    def _stacked_models(self, n_trees: int, grouped: bool):
-        """Stack the first ``n_trees`` trees into one batched Tree pytree
-        (leading axis [T], or [T//K, K] when ``grouped``).  Cached per
-        (n_trees, grouped) and invalidated by the explicit model-version
-        counter (bumped by every mutation of ``self.models``)."""
+    def _versioned_cache(self, attr: str, key, build):
+        """Model-version-keyed memo shared by the stack and table
+        caches: one copy of the invalidation protocol (the explicit
+        _model_version counter, bumped by every mutation of
+        ``self.models``)."""
         version = getattr(self, "_model_version", 0)
-        cache = getattr(self, "_stack_cache", None)
+        cache = getattr(self, attr, None)
         if cache is None or cache[0] != version:
             cache = (version, {})
-            self._stack_cache = cache
-        key = (n_trees, grouped)
+            setattr(self, attr, cache)
         if key not in cache[1]:
+            cache[1][key] = build()
+        return cache[1][key]
+
+    def _stacked_models(self, n_trees: int, grouped: bool):
+        """Stack the first ``n_trees`` trees into one batched Tree pytree
+        (leading axis [T], or [T//K, K] when ``grouped``)."""
+
+        def build():
             stacked = stack_trees(self.models[:n_trees])
             if grouped:
                 K = self.num_class
@@ -625,8 +650,20 @@ class GBDT:
                     lambda a: a.reshape((n_trees // K, K) + a.shape[1:]),
                     stacked,
                 )
-            cache[1][key] = stacked
-        return cache[1][key]
+            return stacked
+
+        return self._versioned_cache("_stack_cache", (n_trees, grouped), build)
+
+    def _stacked_tables(self, n_trees: int, grouped: bool):
+        """Path-incidence tables (ops/predict_matmul.py) for the stacked
+        model — cached next to the stack under the same version key."""
+
+        def build():
+            from ..ops.predict_matmul import build_path_tables
+
+            return build_path_tables(self._stacked_models(n_trees, grouped))
+
+        return self._versioned_cache("_table_cache", (n_trees, grouped), build)
 
     def _iter_chunk(self, n_rows: int) -> int:
         """Boosting iterations per prediction dispatch: the ensemble walk
@@ -651,6 +688,28 @@ class GBDT:
         if n_iter == 0:
             return np.zeros((K, X.shape[0]), np.float64)
         stacked = self._stacked_models(n_iter * K, grouped=True)
+        if _use_matmul_predict():
+            from ..ops.predict_matmul import ensemble_sum_matmul
+
+            tables = self._stacked_tables(n_iter * K, grouped=True)
+            # tree-chunking: no long per-row serial walk, so each
+            # dispatch carries ~10x the walk path's rows*trees budget
+            # without nearing the TPU worker watchdog.  ROW-chunking
+            # bounds the per-tree dense intermediates (vals/go/match are
+            # [rows, L]-shaped, ~2.5KB/row at L=255 — 10M rows would
+            # OOM a 16GB chip without it).
+            step = max(1, 10 * self._iter_chunk(min(X.shape[0], _ROW_CHUNK)))
+            parts = []
+            for rlo in range(0, X.shape[0], _ROW_CHUNK):
+                Xc = X[rlo:rlo + _ROW_CHUNK]
+                acc = None
+                for lo in range(0, n_iter, step):
+                    part = jax.tree.map(lambda a: a[lo:lo + step], stacked)
+                    tpart = jax.tree.map(lambda a: a[lo:lo + step], tables)
+                    out = ensemble_sum_matmul(tpart, part, Xc)
+                    acc = out if acc is None else acc + out
+                parts.append(np.asarray(acc, np.float64))
+            return np.concatenate(parts, axis=1)
         step = self._iter_chunk(X.shape[0])
         acc = None
         for lo in range(0, n_iter, step):
@@ -685,6 +744,22 @@ class GBDT:
         stacked = self._stacked_models(n_iter * K, grouped=False)
         # flat tree-major stack: _iter_chunk already accounts for K
         step = max(K, self._iter_chunk(X.shape[0]) * K)
+        if _use_matmul_predict():
+            from ..ops.predict_matmul import ensemble_leaves_matmul
+
+            tables = self._stacked_tables(n_iter * K, grouped=False)
+            step *= 10  # no serial walk per dispatch; see _raw_scores
+            parts = []
+            for rlo in range(0, X.shape[0], _ROW_CHUNK):
+                Xc = X[rlo:rlo + _ROW_CHUNK]
+                outs = []
+                for lo in range(0, n_iter * K, step):
+                    part = jax.tree.map(lambda a: a[lo:lo + step], stacked)
+                    tpart = jax.tree.map(lambda a: a[lo:lo + step], tables)
+                    outs.append(np.asarray(
+                        ensemble_leaves_matmul(tpart, part, Xc)))
+                parts.append(np.concatenate(outs, axis=0))
+            return np.concatenate(parts, axis=1).T
         outs = []
         for lo in range(0, n_iter * K, step):
             part = jax.tree.map(lambda a: a[lo:lo + step], stacked)

@@ -59,6 +59,16 @@ if TILE <= 0 or TILE % 128 != 0:
         f"lane alignment; the compaction kernel's DMA offsets and the "
         f"cap%TILE assert both require it), got {TILE}"
     )
+# place_runs step-table chunk per launch: a [8, steps] i32 SMEM prefetch
+# block is 32B/step (SMEM pads the minor dim to 128 lanes per ROW, hence
+# the transpose), and the 1MB SMEM budget caps one launch at ~16k steps
+# — the 10M top tier has ~78k.  Read at IMPORT like the other kernel
+# knobs (ADVICE r4): place_runs reads it at trace time, so a mid-process
+# flip would silently not apply to already-traced caps.
+PLACE_CHUNK = int(_os.environ.get("LGBM_TPU_PLACE_CHUNK", "16384"))
+if PLACE_CHUNK <= 0:
+    raise ValueError(
+        f"LGBM_TPU_PLACE_CHUNK must be positive, got {PLACE_CHUNK}")
 
 
 def round_up(x: int, m: int) -> int:
@@ -213,7 +223,8 @@ def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
 
 
 
-def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp):
+def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp,
+                    fgroup=8):
     """Shared left-child histogram accumulation over one [W, T] record
     tile (used by _compact_hist_kernel and _split_step_kernel).  The
     split decision is recomputed from scalars in ROW layout; stats stack
@@ -257,7 +268,11 @@ def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp):
         [grow * mw, hrow * mw, mw, jnp.zeros_like(mw)], axis=0)
 
     iota_s = jax.lax.broadcasted_iota(jnp.int32, (Bp, T), 0)
-    Fp = round_up(F, 8)
+    # caller-sized histogram block: the padded-feature fill below must
+    # cover exactly the caller's round_up(F, fgroup) rows (ADVICE r4 —
+    # a literal 8 here would leave rows [round_up(F,8), Fp) zero and
+    # break parent-minus-left subtraction consistency for fgroup != 8)
+    Fp = round_up(F, fgroup)
     for fi in range(F):
         w_idx, sh = fi // k, (fi % k) * shift
         row = jax.lax.shift_right_logical(
@@ -283,7 +298,8 @@ def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp):
 
 
 def _compact_hist_kernel(
-    scal_ref, win_ref, gcol_ref, out_ref, hist_ref, *, W, F, k, Bp
+    scal_ref, win_ref, gcol_ref, out_ref, hist_ref, *, W, F, k, Bp,
+    fgroup=8
 ):
     """_compact_kernel + LEFT-child histogram accumulation in ONE launch.
 
@@ -324,7 +340,8 @@ def _compact_hist_kernel(
     def hacc_set(fi, contrib):
         hist_ref[0, fi] = hist_ref[0, fi] + contrib
 
-    _hist_tile_body(tile, scal_ref, hacc_set, i, W=W, F=F, k=k, Bp=Bp)
+    _hist_tile_body(tile, scal_ref, hacc_set, i, W=W, F=F, k=k, Bp=Bp,
+                    fgroup=fgroup)
 
 
 @functools.partial(
@@ -406,7 +423,8 @@ def partition_hist_window(
         ],
     )
     comp, hist = pl.pallas_call(
-        functools.partial(_compact_hist_kernel, W=W, F=F, k=k, Bp=Bp),
+        functools.partial(_compact_hist_kernel, W=W, F=F, k=k, Bp=Bp,
+                          fgroup=fgroup),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
@@ -549,7 +567,7 @@ def write_window(rec, out_win, begin, cap: int, interpret: bool = False):
 def _split_step_kernel(
     scal_i_ref, scal_f_ref, win_ref, gcol_ref, hrow_ref, meta_ref,
     hists_out_ref, comp_ref, res_ref, hacc_ref,
-    *, W, F, k, Bp, nt,
+    *, W, F, k, Bp, nt, fgroup=8,
 ):
     """The WHOLE split step in one launch: per-tile MXU compaction +
     left-child histogram accumulation (steps 0..nt-1), then subtract +
@@ -590,7 +608,8 @@ def _split_step_kernel(
         def hacc_set(fi, contrib):
             hacc_ref[fi] = hacc_ref[fi] + contrib
 
-        _hist_tile_body(tile, scal_i_ref, hacc_set, i, W=W, F=F, k=k, Bp=Bp)
+        _hist_tile_body(tile, scal_i_ref, hacc_set, i, W=W, F=F, k=k,
+                        Bp=Bp, fgroup=fgroup)
 
     @pl.when(i == nt)
     def _():
@@ -751,11 +770,7 @@ def place_runs(
 
     rows = _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
                         left_leaf, right_leaf, do_split, nt)
-    # chunk the step table across launches: a [8, steps] i32 SMEM
-    # prefetch block is 32B/step (SMEM pads the minor dim to 128 lanes
-    # per ROW, hence the transpose), and the 1MB SMEM budget caps one
-    # launch at ~16k steps — the 10M top tier has ~78k
-    CHUNK = int(_os.environ.get("LGBM_TPU_PLACE_CHUNK", "16384"))
+    CHUNK = PLACE_CHUNK
     total = 4 * nt
     n_chunks = -(-total // CHUNK)
     for c in range(n_chunks):
@@ -867,7 +882,8 @@ def split_step_window(
     )
     hists_new, comp, res = pl.pallas_call(
         functools.partial(
-            _split_step_kernel, W=W, F=F, k=k, Bp=Bp, nt=nt),
+            _split_step_kernel, W=W, F=F, k=k, Bp=Bp, nt=nt,
+            fgroup=fgroup),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((P, Fp, 4, Bp), jnp.float32),
@@ -889,9 +905,9 @@ def split_step_window(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cap", "leaf_row", "interpret"))
+    jax.jit, static_argnames=("cap", "leaf_row", "direct", "interpret"))
 def partition_window(
-    rec: jax.Array,  # [W, n_pad] i32
+    rec: jax.Array,  # [W, n_pad] i32 (aliased in-kernel when direct)
     go: jax.Array,  # [cap] i32: left-going (valid rows only)
     begin: jax.Array,
     pcnt: jax.Array,
@@ -900,6 +916,7 @@ def partition_window(
     left_leaf: jax.Array | None = None,
     right_leaf: jax.Array | None = None,
     leaf_row: int = -1,  # record row to stamp child leaf ids into
+    direct: bool = False,  # aliased in-kernel placement (place_runs)
     interpret: bool = False,
 ):
     """Stably partition window [begin, begin+cap) of ``rec``: the
@@ -946,6 +963,16 @@ def partition_window(
         out_shape=jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
         interpret=interpret,
     )(win, gov.reshape(cap, 1))
+
+    if direct and not interpret:
+        # aliased in-kernel placement: no scan-of-DUS and no copy of
+        # the record through downstream cond boundaries (place_runs
+        # itself falls back to _xla_place under interpret)
+        rec2 = place_runs(
+            rec, comp, gov, begin, pcnt, nleft, do_split,
+            left_leaf, right_leaf, cap=cap, leaf_row=leaf_row,
+            interpret=interpret)
+        return rec2, nleft
 
     rec2 = _xla_place(
         rec, win, comp, loff, roff, nleft, iota, valid, do_split, begin,

@@ -74,6 +74,7 @@ def make_feature_parallel_grower(mesh, num_bins: int, max_leaves: int,
             bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params,
             num_bins=num_bins, max_leaves=max_leaves,
             hist_fn=hist_fn, search_fn=search_fn, hist_pool=hist_pool,
+            record_mode=True,
         )
 
     sharded = jax.shard_map(

@@ -92,6 +92,7 @@ def make_grid_parallel_grower(mesh: Mesh, num_bins: int, max_leaves: int,
             reduce_fn=lambda x: jax.lax.psum(x, ROW_AXIS),
             reduce_max_fn=lambda x: jax.lax.pmax(x, ROW_AXIS),
             hist_pool=hist_pool,
+            record_mode=True,
         )
 
     sharded = jax.shard_map(

@@ -224,7 +224,7 @@ def sync_config_across_processes(cfg) -> None:
 def make_multihost_data_parallel_grower(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
     growth: str = "leafwise", sorted_hist: bool = False,
-    hist_pool: int = 0,
+    hist_pool: int = 0, record: bool = True,
 ):
     """Data-parallel grower across processes: each process feeds its
     LOCAL row partition (the per-rank ingest split, io/distributed.py);
@@ -242,7 +242,7 @@ def make_multihost_data_parallel_grower(
     sharded = jax.jit(
         data_parallel_sharded(
             mesh, num_bins, max_leaves, axis=axis, growth=growth,
-            sorted_hist=sorted_hist, hist_pool=hist_pool,
+            sorted_hist=sorted_hist, hist_pool=hist_pool, record=record,
         )
     )
     col_s = NamedSharding(mesh, P(None, axis))

@@ -91,6 +91,7 @@ def make_voting_parallel_grower(
             search_fn=search_fn,
             reduce_max_fn=lambda x: jax.lax.pmax(x, axis),
             hist_pool=hist_pool,
+            record_mode=True,
         )
 
     sharded = jax.shard_map(

@@ -1,0 +1,144 @@
+"""Matmul-prediction parity: ops/predict_matmul.py vs the canonical
+vectorized walk (models/tree.py) on the SAME stacked trees.
+
+The matmul path promises bitwise-identical per-tree outputs (one-hot
+selection matmuls are exact; path-count matmuls are small-integer
+exact), so the suites pin equality, not tolerance — any drift is a
+routing bug, not float noise.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.tree import (
+    ensemble_leaves_raw, ensemble_sum_raw, stack_trees)
+from lightgbm_tpu.ops.predict_matmul import (
+    build_path_tables, ensemble_leaves_matmul, ensemble_sum_matmul)
+
+
+def _train(params, X, y, rounds=12):
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train({**params, "verbose": -1}, ds, num_boost_round=rounds)
+
+
+def _data(n=900, f=12, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=n) > 0)
+    return X, y.astype(np.float32)
+
+
+def _check_model(bst, X, K=1):
+    import jax.numpy as jnp
+
+    gb = bst._gbdt if hasattr(bst, "_gbdt") else bst
+    T = len(gb.models)
+    stacked = stack_trees(gb.models)
+    flat_tables = build_path_tables(stacked)
+    Xj = jnp.asarray(X)
+
+    leaves_walk = np.asarray(ensemble_leaves_raw(stacked, Xj))
+    leaves_mm = np.asarray(ensemble_leaves_matmul(flat_tables, stacked, Xj))
+    np.testing.assert_array_equal(leaves_mm, leaves_walk)
+
+    import jax
+
+    grouped = jax.tree.map(
+        lambda a: a.reshape((T // K, K) + a.shape[1:]), stacked)
+    gtables = build_path_tables(grouped)
+    s_walk = np.asarray(ensemble_sum_raw(grouped, Xj))
+    s_mm = np.asarray(ensemble_sum_matmul(gtables, grouped, Xj))
+    np.testing.assert_array_equal(s_mm, s_walk)
+
+
+def test_binary_parity():
+    X, y = _data()
+    bst = _train({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 5}, X, y)
+    _check_model(bst, X)
+
+
+def test_multiclass_parity():
+    X, y = _data()
+    y3 = (np.abs(X[:, 0]) * 2).astype(int) % 3
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 15, "min_data_in_leaf": 5}, X, y3,
+                 rounds=6)
+    _check_model(bst, X, K=3)
+
+
+def test_categorical_parity():
+    rng = np.random.default_rng(7)
+    n = 800
+    Xc = rng.integers(0, 9, size=(n, 2)).astype(np.float32)
+    Xn = rng.normal(size=(n, 3)).astype(np.float32)
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc[:, 0] == 3) | (Xn[:, 0] > 0.5)).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0, 1])
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1}, ds, num_boost_round=8)
+    _check_model(bst, X)
+
+
+def test_stump_trees():
+    # min_gain huge -> every tree is a single-leaf stump; the matmul
+    # path must land every row in leaf 0
+    X, y = _data(n=300)
+    bst = _train({"objective": "binary", "num_leaves": 31,
+                  "min_gain_to_split": 1e9}, X, y, rounds=3)
+    _check_model(bst, X)
+
+
+def test_loaded_model_parity(tmp_path):
+    X, y = _data()
+    bst = _train({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 5}, X, y)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    _check_model(bst2, X)
+
+
+def test_booster_predict_uses_matmul(monkeypatch):
+    # the Booster-level path with the env force must agree with the walk
+    X, y = _data()
+    bst = _train({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 5}, X, y)
+    from lightgbm_tpu.models import gbdt as gbdt_mod
+
+    walk = bst.predict(X, raw_score=True)
+    leaves_walk = bst.predict(X, pred_leaf=True)
+    monkeypatch.setattr(gbdt_mod, "_PREDICT_MM", "1")
+    gb = bst._gbdt if hasattr(bst, "_gbdt") else bst
+    gb._table_cache = None
+    mm = bst.predict(X, raw_score=True)
+    leaves_mm = bst.predict(X, pred_leaf=True)
+    np.testing.assert_array_equal(np.asarray(mm), np.asarray(walk))
+    np.testing.assert_array_equal(np.asarray(leaves_mm),
+                                  np.asarray(leaves_walk))
+
+
+def test_inf_and_nan_routing():
+    """+/-inf must route like the walk (inf right, -inf left); a NaN or
+    inf in ONE feature must not contaminate nodes splitting on OTHER
+    features (the 0*inf=NaN selection-matmul hazard)."""
+    X, y = _data(n=600)
+    bst = _train({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 5}, X, y)
+    gb = bst._gbdt if hasattr(bst, "_gbdt") else bst
+    import jax.numpy as jnp
+
+    Xe = X[:64].copy()
+    Xe[:16, 0] = np.inf
+    Xe[16:32, 0] = -np.inf
+    Xe[32:48, 3] = np.nan
+    stacked = stack_trees(gb.models)
+    tables = build_path_tables(stacked)
+    leaves_mm = np.asarray(
+        ensemble_leaves_matmul(tables, stacked, jnp.asarray(Xe)))
+    # walk reference on the SAME sanitized values (NaN routes right in
+    # the walk too: NaN <= t is false)
+    leaves_walk = np.asarray(ensemble_leaves_raw(stacked, jnp.asarray(Xe)))
+    np.testing.assert_array_equal(leaves_mm, leaves_walk)

@@ -1,0 +1,66 @@
+"""Worker for __graft_entry__.dryrun_multichip's multi-PROCESS stage:
+one process of an N-process jax.distributed world (1 CPU device each),
+growing one data-parallel RECORD-mode tree on its row partition — the
+v5e-8 pod-slice topology analog, so the first real multi-chip window
+goes straight to measurement (VERDICT r4 item 6c).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    .replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=1"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    coord = os.environ["LGBM_TPU_COORDINATOR"]
+    pid = int(os.environ["LGBM_TPU_PROCESS_ID"])
+    NP = int(os.environ["LGBM_TPU_NUM_PROCESSES"])
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=NP, process_id=pid)
+    assert jax.process_count() == NP
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.learners.serial import TreeLearnerParams
+    from lightgbm_tpu.parallel import data_mesh
+    from lightgbm_tpu.parallel.multihost import (
+        make_multihost_data_parallel_grower)
+
+    # a 10M-fraction shape: each rank holds n/NP contiguous rows of a
+    # HIGGS-like column count; leaf budget kept modest so the interpret-
+    # mode record kernels stay inside a dry-run time budget
+    n, F, B, L = int(os.environ.get("LGBM_DRYRUN_MP_ROWS", "16384")), 28, 64, 31
+    rng = np.random.RandomState(7)
+    bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = (np.abs(rng.randn(n)) + 0.1).astype(np.float32)
+    half = n // NP
+    lo, hi = pid * half, (pid + 1) * half
+
+    params = TreeLearnerParams.from_config(Config(min_data_in_leaf=20))
+    grow = make_multihost_data_parallel_grower(
+        data_mesh(), num_bins=B, max_leaves=L, record=True)
+    tree, leaf_local = grow(
+        bins[:, lo:hi], grad[lo:hi], hess[lo:hi],
+        np.ones(half, np.float32), np.ones(F, bool),
+        np.full(F, B, np.int32), np.zeros(F, bool), params)
+    nl = int(tree.num_leaves)
+    assert nl > 1, "multi-process record-mode tree grew no splits"
+    assert leaf_local.shape == (half,)
+    print(f"DRYRUN_MP_OK pid={pid} num_leaves={nl}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,20 @@ def main():
         X, Metadata(label=y.astype(np.float32)), config=cfg)
     booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
 
+    # BENCH_LEARNER=dp_record|dp_canonical traces the data-parallel
+    # grower's per-shard program on however many devices exist (a
+    # 1-device mesh on the real chip exposes the DP loop structure)
+    learner = os.environ.get("BENCH_LEARNER", "serial")
+    if learner.startswith("dp_"):
+        from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower
+
+        booster._grow = make_data_parallel_grower(
+            data_mesh(num_devices=len(jax.devices())),
+            num_bins=booster._num_bins, max_leaves=booster.max_leaves,
+            sorted_hist=booster._use_pallas_hist(),
+            record=(learner == "dp_record"))
+        print("learner:", learner, flush=True)
+
     t0 = time.perf_counter()
     booster.train_one_iter()  # compile + warm
     np.asarray(booster._scores[0, :1])
