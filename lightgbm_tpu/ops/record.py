@@ -173,65 +173,14 @@ def unpack_window(win: jax.Array, F: int, k: int, bin_dtype):
     return bins, g, h, m
 
 
-def _compact_body(tile, g, W):
-    """Shared MXU one-hot stable-compaction math (used by both the plain
-    and the fused kernel): route tile columns so lefts land in [0, T)
-    and everything else in [T, 2T), original order inside each.
+def _tile_go(tile, scal_i_ref, i, *, F, k):
+    """Left-going flags of one [W, T] record tile, recomputed IN-KERNEL
+    from the split scalars — the [cap, 1] go-column operand this
+    replaces cost a layout copy per split per tier on the XLA side
+    (profiled ~300 ms/tree at 10M rows: {0,1:T(1,128)} ->
+    {1,0:T(8,128)} relayouts of every tier's column).
 
-    tile [W, T] i32, g [T, 1] f32 (1.0 = left, valid only) -> [W, 2T].
-    """
-    T = TILE
-    # strict-lower triangular: Lt[t, b] = 1.0 iff b < t; positions via
-    # MXU dots (inputs 0/1 -> exact at any precision, f32 accumulation)
-    t_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    b_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
-    lt = (b_i < t_i).astype(jnp.float32)
-    lpos = jax.lax.dot_general(
-        lt, g, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)  # [T, 1] lefts before t
-    rpos = jax.lax.dot_general(
-        lt, 1.0 - g, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    pos = jnp.where(g > 0, lpos, rpos + T).astype(jnp.int32)  # [T, 1]
-
-    hot = (pos == jax.lax.broadcasted_iota(jnp.int32, (T, 2 * T), 1)
-           ).astype(jnp.float32)  # [T, 2T] routing matrix
-    comp = jnp.zeros((W, 2 * T), jnp.int32)
-    for b in range(4):
-        byte = ((tile >> (8 * b)) & 0xFF).astype(jnp.float32)
-        m = jax.lax.dot_general(
-            byte, hot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [W, 2T]
-        comp = comp | (m.astype(jnp.int32) << (8 * b))
-    return comp
-
-
-def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
-    """One grid step = one [W, T] tile: MXU one-hot stable compaction.
-
-    win_ref  [W, T] i32    : this tile of the record window
-    gcol_ref [T, 1] i32    : go flags (1 = left, valid only)
-    out_ref  [1, W, 2T] i32: lefts compacted to [0, T), everything else
-                             to [T, 2T), original order inside each
-
-    Placement at the (unaligned) global run offsets happens in an XLA
-    dynamic-update-slice scan outside — Mosaic DMA slices must be
-    128-lane aligned, which arbitrary compaction offsets are not.
-    """
-    out_ref[0] = _compact_body(
-        win_ref[...], gcol_ref[...].astype(jnp.float32), W)
-
-
-
-def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp,
-                    fgroup=8):
-    """Shared left-child histogram accumulation over one [W, T] record
-    tile (used by _compact_hist_kernel and _split_step_kernel).  The
-    split decision is recomputed from scalars in ROW layout; stats stack
-    on sublanes; the one-hot is born transposed against a sublane iota
-    and contracts the shared lane axis on the MXU — no relayouts.
-
-    ``hacc_set(fi, contrib)`` accumulates [4, Bp] into feature row fi.
+    Returns [1, T] f32: 1.0 = left AND valid (rows past pcnt are 0).
     scal_i layout: (.., .., .., .., f, thr, is_cat, pcnt) — indices 4-7.
     """
     T = TILE
@@ -255,7 +204,122 @@ def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp,
     # ARITHMETIC select: an i1-on-i1 arith.select fails legalization
     go = is_cat * (fv == thr).astype(jnp.int32) + (1 - is_cat) * (
         fv <= thr).astype(jnp.int32)
-    govf = (go * valid).astype(jnp.float32)
+    return (go * valid).astype(jnp.float32)
+
+
+def _compact_body(tile, g, W):
+    """Shared MXU one-hot stable-compaction math (used by both the plain
+    and the fused kernel): route tile columns so lefts land in [0, T)
+    and everything else in [T, 2T), original order inside each.
+
+    tile [W, T] i32, g [1, T] f32 ROW (1.0 = left, valid only) ->
+    [W, 2T].  The row form contracts directly on the lane axis — no
+    [1,T]->[T,1] in-kernel relayout and no column operand from XLA.
+    """
+    T = TILE
+    # strict-lower triangular: Lt[t, b] = 1.0 iff b < t; positions via
+    # MXU dots (inputs 0/1 -> exact at any precision, f32 accumulation)
+    t_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    b_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    lt = (b_i < t_i).astype(jnp.float32)
+    lte = (b_i <= t_i).astype(jnp.float32)
+    # position dots stay f32: their FLOPs are negligible (T-wide
+    # outputs) and Mosaic rejects bf16 dots with unit minor dims
+    # ('vector.broadcast' element-type verification, seen on-chip)
+    contract_lane = (((1,), (1,)), ((), ()))
+    lpos = jax.lax.dot_general(
+        lt, g, contract_lane,
+        preferred_element_type=jnp.float32)  # [T, 1] lefts before t
+    # inclusive count recovers the column-form flag without a relayout:
+    # g_col[t] = lefts(<=t) - lefts(<t) in {0.0, 1.0}
+    lpos_inc = jax.lax.dot_general(
+        lte, g, contract_lane, preferred_element_type=jnp.float32)
+    g_col = lpos_inc - lpos  # [T, 1]
+    rpos = jax.lax.dot_general(
+        lt, 1.0 - g, contract_lane,
+        preferred_element_type=jnp.float32)
+    # arithmetic select (g_col is exact 0/1 f32); the +T right-half
+    # offset is applied in INT after the cast — written as rpos + T it
+    # gets folded into the dot's accumulator init, which Mosaic rejects
+    # ("only neutral accumulator supported for float reduction")
+    pos = (g_col * lpos + (1.0 - g_col) * rpos).astype(jnp.int32)
+    pos = pos + (1 - g_col.astype(jnp.int32)) * T
+
+    return _route_bytes(tile, pos, W)
+
+
+def _route_bytes(tile, pos, W):
+    """Apply the one-hot routing matrix built from ``pos`` [T, 1] to the
+    four i32 byte planes.  The BYTE routing dots carry ~all the
+    compaction FLOPs (O(n*T) per level): bf16 inputs + f32 accumulation
+    are EXACT here — bytes are integers < 256 (8 mantissa bits suffice)
+    and the one-hot gives each output cell exactly one nonzero addend —
+    while cutting the MXU pass count 3x vs f32's bf16x3 decomposition
+    (these dots profiled ~1.2 s/tree of device time at 10M rows)."""
+    T = TILE
+    hot = (pos == jax.lax.broadcasted_iota(jnp.int32, (T, 2 * T), 1)
+           ).astype(jnp.bfloat16)  # [T, 2T] routing matrix
+    comp = jnp.zeros((W, 2 * T), jnp.int32)
+    for b in range(4):
+        byte = ((tile >> (8 * b)) & 0xFF).astype(jnp.bfloat16)
+        m = jax.lax.dot_general(
+            byte, hot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [W, 2T]
+        comp = comp | (m.astype(jnp.int32) << (8 * b))
+    return comp
+
+
+def _compact_body_col(tile, g, W):
+    """Column-operand variant of _compact_body (g [T, 1] f32): used by
+    partition_window, whose go flags arrive as an explicit vector (a
+    [nt, T] row-block operand is not a legal Mosaic block shape —
+    sublane dim 1 — while the [cap, 1] column's (T, 1) block is)."""
+    T = TILE
+    t_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    b_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    lt = (b_i < t_i).astype(jnp.float32)
+    lpos = jax.lax.dot_general(
+        lt, g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [T, 1] lefts before t
+    rpos = jax.lax.dot_general(
+        lt, 1.0 - g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    pos = jnp.where(g > 0, lpos, rpos + T).astype(jnp.int32)  # [T, 1]
+    return _route_bytes(tile, pos, W)
+
+
+def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
+    """One grid step = one [W, T] tile: MXU one-hot stable compaction.
+
+    win_ref  [W, T] i32    : this tile of the record window
+    gcol_ref [T, 1] i32    : go flags (1 = left, valid only)
+    out_ref  [1, W, 2T] i32: lefts compacted to [0, T), everything else
+                             to [T, 2T), original order inside each
+
+    Placement at the (unaligned) global run offsets happens in an XLA
+    dynamic-update-slice scan outside — Mosaic DMA slices must be
+    128-lane aligned, which arbitrary compaction offsets are not.
+    """
+    out_ref[0] = _compact_body_col(
+        win_ref[...], gcol_ref[...].astype(jnp.float32), W)
+
+
+
+def _hist_tile_body(tile, scal_i_ref, hacc_set, *, W, F, k, Bp,
+                    govf, fgroup=8):
+    """Shared left-child histogram accumulation over one [W, T] record
+    tile (used by _split_step_kernel via _split_tile).  The split
+    decision ``govf`` is the SAME [1, T] row the compaction used
+    (_tile_go); stats stack on sublanes; the one-hot is born transposed
+    against a sublane iota and contracts the shared lane axis on the
+    MXU — no relayouts.
+
+    ``hacc_set(fi, contrib)`` accumulates [4, Bp] into feature row fi.
+    scal_i layout: (.., .., .., .., f, thr, is_cat, pcnt) — indices 4-7.
+    """
+    T = TILE
+    shift = 32 // k
+    mask_v = (1 << shift) - 1
 
     Wb = num_words(F, k)
     grow = jax.lax.bitcast_convert_type(tile[Wb: Wb + 1, :], jnp.float32)
@@ -297,155 +361,16 @@ def _hist_tile_body(tile, scal_i_ref, hacc_set, i, *, W, F, k, Bp,
             hacc_set(fi, contrib0)
 
 
-def _compact_hist_kernel(
-    scal_ref, win_ref, gcol_ref, out_ref, hist_ref, *, W, F, k, Bp,
-    fgroup=8
-):
-    """_compact_kernel + LEFT-child histogram accumulation in ONE launch.
-
-    The round-3 profile (BASELINE.md) showed ~0.35 ms PER Pallas launch
-    of pure dispatch cost; the separate smaller-child histogram launch
-    was ~40% of the split loop's kernel count.  The left child's
-    histogram is a sum over exactly the rows this kernel is already
-    routing — so accumulate it here, in the raw [Fp, 4, Bp] layout the
-    search kernel wants, and let the sibling come from the parent by
-    subtraction (feature_histogram.hpp:97-106) as before.  The larger
-    child is no longer necessarily the subtracted one — equivalent under
-    exact arithmetic, and cheaper than a second launch.
-
-    scal_ref [4] i32      : (f, thr, is_cat, pcnt) — split feature/
-                            threshold (clamped f>=0) and the parent's
-                            positional count for validity
-    win_ref  [W, T] i32   : this tile of the record window
-    gcol_ref [T, 1] i32   : go flags (left, valid only) for routing
-    out_ref  [1, W, 2T]   : compacted tile (see _compact_kernel)
-    hist_ref [1, Fp, 4, Bp] f32: left-child histogram, SAME block every
-                            grid step (VMEM-resident accumulator)
-
-    All histogram math stays in ROW layout (bins live in lanes): the
-    one-hot is born transposed against a sublane iota and contracts the
-    shared lane axis on the MXU — no [1,T]->[T,1] relayouts anywhere.
-    """
-    T = TILE
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _():
-        hist_ref[...] = jnp.zeros_like(hist_ref)
-
-    tile = win_ref[...]  # [W, T] i32
-    out_ref[0] = _compact_body(
-        tile, gcol_ref[...].astype(jnp.float32), W)
-
-    def hacc_set(fi, contrib):
-        hist_ref[0, fi] = hist_ref[0, fi] + contrib
-
-    _hist_tile_body(tile, scal_ref, hacc_set, i, W=W, F=F, k=k, Bp=Bp,
-                    fgroup=fgroup)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("F", "cap", "num_bins", "k", "fgroup",
-                              "interpret")
-)
-def partition_hist_window(
-    rec: jax.Array,  # [W, n_pad] i32
-    go: jax.Array,  # [cap] i32: left-going (valid rows only)
-    begin: jax.Array,
-    pcnt: jax.Array,
-    do_split: jax.Array,
-    f: jax.Array,  # split feature (clamped >= 0 on no-op steps)
-    thr: jax.Array,  # split threshold bin
-    is_cat: jax.Array,  # bool
-    F: int,
-    cap: int,
-    num_bins: int,
-    k: int,  # bins per word (4 for u8 bins, 2 for u16)
-    left_leaf: jax.Array | None = None,  # stamp into the leaf-id row
-    right_leaf: jax.Array | None = None,
-    fgroup: int = 8,
-    interpret: bool = False,
-):
-    """partition_window + left-child histogram in the SAME kernel launch.
-
-    Returns (rec', nleft, hist_left[Fp, 4, Bp]) with Fp = F padded to
-    ``fgroup`` and Bp = bins padded to a lane multiple — the raw layout
-    of ops/pallas_histogram histogram_single_leaf_raw, so the split step
-    feeds the search kernel with no extra launch and no relayout.
-
-    With ``left_leaf``/``right_leaf`` given, the record's leaf-id row
-    (row num_words+4) is stamped with the child ids over the parent's
-    valid range — the partition IS the leaf assignment (see rec_height).
-    """
-    W = rec.shape[0]
-    T = TILE
-    assert cap % T == 0, (cap, T)
-    nt = cap // T
-    Bp = round_up(num_bins, 128)
-    Fp = round_up(F, fgroup)
-
-    win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    # integer arithmetic end-to-end: [cap]/[cap,1] pred tensors pay
-    # bit-layout relayout copies on this stack (profiled ~100 ms/tree
-    # at 1M; callers pass go as i32 via serial._go_i32)
-    valid = (iota < pcnt).astype(jnp.int32)
-    gov = jnp.asarray(go).astype(jnp.int32) * valid
-    nleft = jnp.sum(gov, dtype=jnp.int32)
-
-    kt = gov.reshape(nt, T)
-    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
-    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
-    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
-    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
-
-    z = jnp.int32(0)
-    # 8-wide, SAME layout as split_step_window's scal_i: _hist_tile_body
-    # reads (f, thr, is_cat, pcnt) at indices 4-7 (review r4 caught a
-    # 4-wide pack here silently reading out of bounds)
-    scal = jnp.stack([
-        z, z, z, z,
-        jnp.maximum(f, 0).astype(jnp.int32),
-        thr.astype(jnp.int32),
-        is_cat.astype(jnp.int32),
-        pcnt.astype(jnp.int32),
-    ])
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nt,),
-        in_specs=[
-            pl.BlockSpec((W, T), lambda i, s: (0, i)),
-            pl.BlockSpec((T, 1), lambda i, s: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, W, 2 * T), lambda i, s: (i, 0, 0)),
-            pl.BlockSpec((1, Fp, 4, Bp), lambda i, s: (0, 0, 0, 0)),
-        ],
-    )
-    comp, hist = pl.pallas_call(
-        functools.partial(_compact_hist_kernel, W=W, F=F, k=k, Bp=Bp,
-                          fgroup=fgroup),
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
-            jax.ShapeDtypeStruct((1, Fp, 4, Bp), jnp.float32),
-        ],
-        interpret=interpret,
-    )(scal, win, gov.reshape(cap, 1))
-
-    rec2 = _xla_place(
-        rec, win, comp, loff, roff, nleft, iota, valid, do_split, begin,
-        cap, leaf_row=num_words(F, k) + 4 if left_leaf is not None else -1,
-        left_leaf=left_leaf, right_leaf=right_leaf)
-    return rec2, nleft, hist[0]
-
+# NOTE: the round-4 fused compact+hist kernel pair (_compact_hist_kernel /
+# partition_hist_window) was deleted in round 5: split_step_window
+# superseded it and it had no callers left (ADVICE r4).
 
 
 def _xla_place(rec, win, comp, loff, roff, nleft, iota, valid, do_split,
                begin, cap, leaf_row=-1, left_leaf=None, right_leaf=None):
     """Reference XLA placement: scan-of-DUS run packing + roll/merge +
     optional leaf-id stamping + window write-back.  Shared by
-    partition_window, partition_hist_window, split_step_window, and
+    partition_window, split_step_window, and
     place_runs' interpret fallback — the hardware path (ops.record
     place_runs kernel) is parity-checked against THIS implementation."""
     T = TILE
@@ -564,54 +489,131 @@ def write_window(rec, out_win, begin, cap: int, interpret: bool = False):
     )(scal, out_win, out_win, rec)
 
 
+def _split_tile(tile, scal_i_ref, j, comp_ref, cnt_ref, hacc_ref, *,
+                W, F, k, Bp, fgroup):
+    """Per-tile work of the split step: ONE in-kernel go computation
+    (no [cap, 1] column operand from XLA — see _tile_go) shared by the
+    MXU compaction, the per-tile left-count output, and the left-child
+    histogram accumulation.  ``j`` is the tile ordinal (validity)."""
+    govf = _tile_go(tile, scal_i_ref, j, F=F, k=k)
+    comp_ref[0] = _compact_body(tile, govf, W)
+    cnt_ref[...] = jnp.zeros((1, 128), jnp.int32) + jnp.sum(
+        govf).astype(jnp.int32)
+
+    def hacc_set(fi, contrib):
+        hacc_ref[fi] = hacc_ref[fi] + contrib
+
+    _hist_tile_body(tile, scal_i_ref, hacc_set, W=W, F=F, k=k,
+                    Bp=Bp, fgroup=fgroup, govf=govf)
+
+
 def _split_step_kernel(
-    scal_i_ref, scal_f_ref, win_ref, gcol_ref, hrow_ref, meta_ref,
-    hists_out_ref, comp_ref, res_ref, hacc_ref,
-    *, W, F, k, Bp, nt, fgroup=8,
+    scal_i_ref, scal_f_ref, *refs,
+    W, F, k, Bp, nt, fgroup=8, direct_read=False,
 ):
     """The WHOLE split step in one launch: per-tile MXU compaction +
     left-child histogram accumulation (steps 0..nt-1), then subtract +
     two-child search + in-place histogram-buffer row updates (steps nt
-    and nt+1) — the union of _compact_hist_kernel and
+    and nt+1) — the union of the tile compaction and
     pallas_search._fused_kernel, eliminating one ~0.35 ms launch floor
     plus the [Fp, 4, Bp] h_small round trip through HBM per split.
 
-    scal_i [8]: (parent_slot, left_slot, new_slot, do_split, f, thr,
-                 is_cat, pcnt)
+    scal_i [10]: (parent_slot, left_slot, new_slot, do_split, f, thr,
+                 is_cat, pcnt, begin//T, begin%T)
     scal_f [16]: pallas_search._pack_scal layout
-    hrow_ref   : hists row — parent slot for steps <= nt, new slot after
-    hists_out  : left row at step nt, right row at step nt+1
+    win_ref    : the [W, T] window tile (non-direct mode).  With
+                 ``direct_read`` the RECORD itself is the (single,
+                 ALIASED) data operand: each step fetches one T-aligned
+                 block and writes it back unchanged through the aliased
+                 output, and the unaligned window tile i-1 is
+                 roll-merged from the PREVIOUS block (VMEM scratch) and
+                 the current one — the grid gains one pipeline step.
+                 The single-mention aliased pass-through is what lets
+                 XLA chain the record in place through place_runs: any
+                 second read of the record (a window slice, a go
+                 vector, a sibling block view) made copy-insertion
+                 clone the full record every split (~1-2 s/tree at 10M
+                 rows, measured both ways).
+    hrow_ref   : hists row — parent slot until the search step, new
+                 slot on the last
+    hists_out  : left row at the search step, right row on the last
+    cnt_ref    : [1, 128] i32 per tile — lane 0 carries this tile's
+                 LEFT count, so the XLA side derives cl/cr/nleft with
+                 no go vector (and no record read) at all
     hacc_ref   : VMEM scratch — left-child histogram accumulator, then
-                 the right-child stash between steps nt and nt+1
+                 the right-child stash between the last two steps
     """
     from .pallas_search import K_EPSILON, _child_search, _tail_of, _tri
+
+    if direct_read:
+        (rec_ref, hrow_ref, meta_ref, hists_out_ref,
+         comp_ref, res_ref, cnt_ref, rec_out_ref, hacc_ref,
+         prev_ref) = refs
+    else:
+        (win_ref, hrow_ref, meta_ref, hists_out_ref, comp_ref,
+         res_ref, cnt_ref, hacc_ref) = refs
 
     T = TILE
     i = pl.program_id(0)
     do_split = scal_i_ref[3] > 0
+    off = 1 if direct_read else 0  # pipeline offset of the tile steps
+    search_step = nt + off
+    last_step = nt + 1 + off
 
     @pl.when(i == 0)
     def _():
         hacc_ref[...] = jnp.zeros_like(hacc_ref)
 
-    @pl.when(i < nt)
+    if direct_read:
+        @pl.when(i <= nt)
+        def _():
+            # fetch block b0+i and write it back unchanged through the
+            # aliased output; tile j = i-1 is merged from LAST step's
+            # stashed block (prev) and this fetch BEFORE re-stashing
+            cur = rec_ref[...]
+            rec_out_ref[...] = cur
+
+            @pl.when(i >= 1)
+            def _():
+                hists_out_ref[0] = hrow_ref[0]
+                r = scal_i_ref[9]
+                # tile lanes [0, T-r) from prev[:, r:], lanes [T-r, T)
+                # from cur[:, :r): both the same right-rotation by
+                # (T - r) % T (dynamic shifts are the one dynamic-lane
+                # primitive Mosaic supports)
+                prev = prev_ref[...]
+                sh = jax.lax.rem(T - r, T)
+                ra = pltpu.roll(prev, sh, 1)
+                rb = pltpu.roll(cur, sh, 1)
+                lane = jax.lax.broadcasted_iota(jnp.int32, (W, T), 1)
+                m = (lane < (T - r)).astype(jnp.int32)
+                tile = ra * m + rb * (1 - m)
+                _split_tile(tile, scal_i_ref, i - 1, comp_ref, cnt_ref,
+                            hacc_ref, W=W, F=F, k=k, Bp=Bp,
+                            fgroup=fgroup)
+
+            prev_ref[...] = cur
+    else:
+        @pl.when(i < nt)
+        def _():
+            # the output block aliases the PARENT row during tile steps
+            # (si[1] == si[0]); pass the parent through so any
+            # intermediate writeback (interpret mode flushes every
+            # step) is an identity write, never garbage over a row the
+            # search still needs
+            hists_out_ref[0] = hrow_ref[0]
+            _split_tile(win_ref[...], scal_i_ref, i, comp_ref, cnt_ref,
+                        hacc_ref, W=W, F=F, k=k, Bp=Bp, fgroup=fgroup)
+
+    @pl.when(i >= nt + off)
     def _():
-        # the output block aliases the PARENT row during tile steps
-        # (si[1] == si[0]); pass the parent through so any intermediate
-        # writeback (interpret mode flushes every step) is an identity
-        # write, never garbage over a row the search still needs
-        hists_out_ref[0] = hrow_ref[0]
-        tile = win_ref[...]  # [W, T] i32
-        comp_ref[0] = _compact_body(
-            tile, gcol_ref[...].astype(jnp.float32), W)
+        # tail steps revisit tile nt-1's count block: identity rewrite
+        # so interpret mode never flushes it unwritten
+        cnt_ref[...] = cnt_ref[...]
+        if direct_read:
+            rec_out_ref[...] = rec_ref[...]
 
-        def hacc_set(fi, contrib):
-            hacc_ref[fi] = hacc_ref[fi] + contrib
-
-        _hist_tile_body(tile, scal_i_ref, hacc_set, i, W=W, F=F, k=k,
-                        Bp=Bp, fgroup=fgroup)
-
-    @pl.when(i == nt)
+    @pl.when(i == search_step)
     def _():
         parent = hrow_ref[0]  # [Fp, 4, Bp]
         h_left = hacc_ref[...]
@@ -631,7 +633,7 @@ def _split_step_kernel(
                 scal_f_ref, meta_ref, res_ref, hacc_ref.shape[0], B,
             )
 
-    @pl.when(i == nt + 1)
+    @pl.when(i == last_step)
     def _():
         hists_out_ref[0] = jnp.where(do_split, hacc_ref[...], hrow_ref[0])
 
@@ -737,12 +739,13 @@ def _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
 def place_runs(
     rec,  # [W, n_pad] i32 — DONATED, aliased in place
     comp,  # [nt, W, 2T] i32 — the split kernel's compacted tiles
-    go,  # [cap] i32 (same decision column the split kernel consumed)
+    go,  # [cap] i32 decision column, or None when ``counts`` is given
     begin, pcnt, nleft, do_split,
     left_leaf, right_leaf,
     cap: int,
     leaf_row: int,
     interpret: bool = False,
+    counts=None,  # (cl [nt], cr [nt]) from the split kernel's cnt out
 ):
     """Scatter the compacted runs into the record in ONE aliased launch.
     Interpret mode falls back to the (bit-identical, slower) XLA
@@ -753,10 +756,13 @@ def place_runs(
     nt = cap // T
     iota = jnp.arange(cap, dtype=jnp.int32)
     valid = (iota < pcnt).astype(jnp.int32)
-    gov = jnp.asarray(go).astype(jnp.int32) * valid
-    kt = gov.reshape(nt, T)
-    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
-    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
+    if counts is not None:
+        cl, cr = counts
+    else:
+        gov = jnp.asarray(go).astype(jnp.int32) * valid
+        kt = gov.reshape(nt, T)
+        cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
+        cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
     loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
     roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
 
@@ -813,7 +819,6 @@ def place_runs(
 def split_step_window(
     hists,  # [P, Fp, 4, Bp] f32 — DONATED, rows updated in place
     rec,  # [W, n_pad] i32
-    go,  # [cap] i32: left-going (valid rows only)
     begin, pcnt, do_split,
     f, thr, is_cat,  # split decision scalars
     parent_slot, new_slot,  # hists rows (left child reuses parent's)
@@ -826,80 +831,142 @@ def split_step_window(
 ):
     """One-launch split step over window [begin, begin+cap): compaction
     + left-child histogram + subtract + two-child search + in-place
-    hists-row updates.  Returns (hists', rec', nleft, res[2, 16]).
+    hists-row updates.  Returns (hists', rec', nleft, res[2, 16]) — or,
+    with ``return_comp``, (hists', comp, nleft, res, cl, cr, rec_pass)
+    where ``rec_pass`` is the kernel's aliased record pass-through that
+    MUST feed place_runs (feeding the original ``rec`` reintroduces
+    the full-record copy this chain eliminates).
+
+    The split decision AND the per-tile left counts live entirely in
+    the kernel (_tile_go + the cnt output): the XLA side touches the
+    record only through the kernel's block reads (on hardware, two
+    T-aligned blocks roll-merged per tile — no materialized window
+    slice), which is what lets the aliased placement (place_runs)
+    update the record in place across the tier-cond chain instead of
+    paying a full-record copy per split.
 
     The child leaf ids are stamped into the record's leaf-id row (see
     rec_height).  With ``return_comp`` the XLA placement (scan-of-DUS +
-    roll/merge) is SKIPPED and the raw compacted tiles come back as
-    (hists', comp[nt, W, 2T], nleft, res) for ops.record.place_runs —
-    the aliased placement kernel that replaces that whole chain.
+    roll/merge) is SKIPPED and the raw compacted tiles come back for
+    ops.record.place_runs — the aliased placement kernel that replaces
+    that whole chain.
     """
-    W = rec.shape[0]
+    W, n_pad = rec.shape
     T = TILE
     assert cap % T == 0, (cap, T)
+    assert n_pad % T == 0, (n_pad, T)
     nt = cap // T
+    nblocks = n_pad // T
     P, Fp, _, Bp = hists.shape
 
-    win = jax.lax.dynamic_slice(rec, (0, begin), (W, cap))
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    valid = (iota < pcnt).astype(jnp.int32)
-    gov = jnp.asarray(go).astype(jnp.int32) * valid
-    nleft = jnp.sum(gov, dtype=jnp.int32)
-
-    kt = gov.reshape(nt, T)
-    cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
-    cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
-    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
-    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
-
     i32 = functools.partial(jnp.asarray, dtype=jnp.int32)
+    b0 = i32(begin) // T
+    roff_in = i32(begin) % T
     scal_i = jnp.stack([
         i32(parent_slot), i32(parent_slot), i32(new_slot), i32(do_split),
-        jnp.maximum(i32(f), 0), i32(thr), i32(is_cat), i32(pcnt)])
+        jnp.maximum(i32(f), 0), i32(thr), i32(is_cat), i32(pcnt),
+        b0, roff_in])
+
+    direct_read = not interpret
+    off = 1 if direct_read else 0  # pipeline offset (see the kernel)
+    # block walk of the single aliased record view: b0, b0+1, ..,
+    # b0+nt (clamped), parked on the last block for the tail steps
+    def _rec_idx(i, si, sf):
+        return (0, jnp.minimum(si[8] + jnp.minimum(i, nt), nblocks - 1))
+
+    def _tile_idx(i):  # comp/cnt block for the tile processed at step i
+        return jnp.clip(i - off, 0, nt - 1)
+
+    if direct_read:
+        data_in = [rec]
+        data_specs = [pl.BlockSpec((W, T), _rec_idx)]
+    else:
+        # interpret fallback: materialized window slice (pltpu.roll
+        # paths are hardware-only; CPU tests keep the reference DS)
+        data_in = [jax.lax.dynamic_slice(rec, (0, begin), (W, cap))]
+        data_specs = [
+            pl.BlockSpec(
+                (W, T), lambda i, si, sf: (0, jnp.minimum(i, nt - 1))),
+        ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(nt + 2,),
-        in_specs=[
-            pl.BlockSpec((W, T), lambda i, si, sf: (0, jnp.minimum(i, nt - 1))),
-            pl.BlockSpec((T, 1), lambda i, si, sf: (jnp.minimum(i, nt - 1), 0)),
+        grid=(nt + 2 + off,),
+        in_specs=data_specs + [
             pl.BlockSpec(
                 (1, Fp, 4, Bp),
-                lambda i, si, sf: (jnp.where(i <= nt, si[0], si[2]),
+                lambda i, si, sf: (jnp.where(i <= nt + off, si[0], si[2]),
                                    0, 0, 0)),
             pl.BlockSpec((Fp, 4), lambda i, si, sf: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec(
                 (1, Fp, 4, Bp),
-                lambda i, si, sf: (jnp.where(i <= nt, si[1], si[2]),
+                lambda i, si, sf: (jnp.where(i <= nt + off, si[1], si[2]),
                                    0, 0, 0)),
             pl.BlockSpec((1, W, 2 * T),
-                         lambda i, si, sf: (jnp.minimum(i, nt - 1), 0, 0)),
+                         lambda i, si, sf: (_tile_idx(i), 0, 0)),
             pl.BlockSpec((2, 16), lambda i, si, sf: (0, 0)),
-        ],
-        scratch_shapes=[pltpu.VMEM((Fp, 4, Bp), jnp.float32)],
+            # counts ride the LANE axis: a (1, 128) block on [1, nt*128]
+            # is Mosaic-legal (major dim == array dim), a [nt, 128]
+            # row-per-tile layout is not (sublane dim 1)
+            pl.BlockSpec((1, 128),
+                         lambda i, si, sf: (0, _tile_idx(i))),
+        ] + ([
+            # aliased identity pass-through of the record (same block
+            # walk as the input view): the output VALUE feeds
+            # place_runs so every link of the record chain is
+            # single-use — see the kernel docstring's copy note
+            pl.BlockSpec((W, T), _rec_idx),
+        ] if direct_read else []),
+        scratch_shapes=[pltpu.VMEM((Fp, 4, Bp), jnp.float32)] + (
+            [pltpu.VMEM((W, T), jnp.int32)] if direct_read else []),
     )
-    hists_new, comp, res = pl.pallas_call(
+    hists_idx = 2 + len(data_in)  # incl. the 2 prefetch args
+    out_shape = [
+        jax.ShapeDtypeStruct((P, Fp, 4, Bp), jnp.float32),
+        jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
+        jax.ShapeDtypeStruct((2, 16), jnp.float32),
+        jax.ShapeDtypeStruct((1, nt * 128), jnp.int32),
+    ]
+    aliases = {hists_idx: 0}
+    if direct_read:
+        out_shape.append(jax.ShapeDtypeStruct((W, n_pad), jnp.int32))
+        aliases[2] = 4  # recA -> rec pass-through
+    outs = pl.pallas_call(
         functools.partial(
             _split_step_kernel, W=W, F=F, k=k, Bp=Bp, nt=nt,
-            fgroup=fgroup),
+            fgroup=fgroup, direct_read=direct_read),
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((P, Fp, 4, Bp), jnp.float32),
-            jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
-            jax.ShapeDtypeStruct((2, 16), jnp.float32),
-        ],
-        input_output_aliases={4: 0},  # hists (incl. the 2 prefetch args)
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(scal_i, scal_f, win, gov.reshape(cap, 1), hists, meta)
+    )(scal_i, scal_f, *data_in, hists, meta)
+    if direct_read:
+        hists_new, comp, res, cnt, rec_pass = outs
+    else:
+        hists_new, comp, res, cnt = outs
+        rec_pass = rec
+
+    # tile counts from the KERNEL: cl from the cnt output, per-tile
+    # valid counts from pcnt alone — no go vector, no record read
+    cl = cnt.reshape(nt, 128)[:, 0]
+    vt = jnp.clip(pcnt - jnp.arange(nt, dtype=jnp.int32) * T, 0, T)
+    cr = vt - cl
+    nleft = jnp.sum(cl, dtype=jnp.int32)
 
     if return_comp:
-        return hists_new, comp, nleft, res
+        return hists_new, comp, nleft, res, cl, cr, rec_pass
 
+    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
+    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = (iota < pcnt).astype(jnp.int32)
+    win = (data_in[0] if not direct_read
+           else jax.lax.dynamic_slice(rec_pass, (0, begin), (W, cap)))
     rec2 = _xla_place(
-        rec, win, comp, loff, roff, nleft, iota, valid, do_split, begin,
-        cap, leaf_row=num_words(F, k) + 4,
+        rec_pass, win, comp, loff, roff, nleft, iota, valid, do_split,
+        begin, cap, leaf_row=num_words(F, k) + 4,
         left_leaf=parent_slot, right_leaf=new_slot)
     return hists_new, rec2, nleft, res
 

@@ -152,7 +152,7 @@ def check_split(rng) -> bool:
         jnp.full(F, num_bins, jnp.int32), jnp.zeros(F, bool), *lim_args)
 
     histsB, recB, nlB, res = split_step_window(
-        jnp.asarray(hists_np), rec, go, jnp.int32(0), jnp.int32(n),
+        jnp.asarray(hists_np), rec, jnp.int32(0), jnp.int32(n),
         jnp.bool_(True), jnp.int32(f), jnp.int32(thr), jnp.bool_(False),
         jnp.int32(0), jnp.int32(1), scal, meta, F=F, cap=cap, k=k)
 
@@ -270,8 +270,8 @@ def check_place(rng) -> bool:
         scal = _pack_scal(*[jnp.float32(x) for x in
                             (1., 0., 1., 9., 0., 1., 9., 1., 1e-3,
                              0., 0., 0.)])
-        _, comp, nlB, _ = split_step_window(
-            hists, rec, go, begin, jnp.int32(n), jnp.bool_(True),
+        _, comp, nlB, _, clB, crB, _rp = split_step_window(
+            hists, rec, begin, jnp.int32(n), jnp.bool_(True),
             jnp.int32(f), jnp.int32(thr), jnp.bool_(False),
             jnp.int32(3), jnp.int32(5), scal, meta, F=F, cap=cap, k=k,
             return_comp=True)
@@ -279,6 +279,12 @@ def check_place(rng) -> bool:
             jnp.array(rec), comp, go, begin, jnp.int32(n), nlB,
             jnp.bool_(True), jnp.int32(3), jnp.int32(5), cap=cap,
             leaf_row=lr)
+        # kernel-emitted counts must reproduce the go-derived ones
+        govm2 = np.asarray(go).astype(np.int64) * (np.arange(cap) < n)
+        want_cl = govm2.reshape(-1, TILE).sum(axis=1)
+        if not np.array_equal(np.asarray(clB), want_cl):
+            log(f"  place trial {trial}: kernel cl mismatch")
+            ok = False
         if int(nlA) != int(nlB):
             log(f"  place trial {trial}: nleft {int(nlA)} vs {int(nlB)}")
             ok = False
