@@ -277,7 +277,18 @@ class BinnedDataset:
                 used_map[j] = len(used_mappers)
                 used_mappers.append(m)
 
-        dtype = np.uint8 if max((m.num_bin for m in used_mappers), default=1) <= 256 else np.uint16
+        max_nb = max((m.num_bin for m in used_mappers), default=1)
+        if max_nb > 65536:
+            # the reference's u32 dense-bin specialization
+            # (src/io/bin.cpp:304-322) is deliberately not carried: the
+            # packed training record stores bins 2-per-i32 at u16 width
+            # and no realistic config exceeds 65536 bins per feature —
+            # fail loudly instead of silently wrapping the u16 cast
+            raise ValueError(
+                f"a feature produced {max_nb} bins; this build supports "
+                f"max 65536 bins per feature (uint16 storage) — lower "
+                f"max_bin or bin_construct_sample_cnt")
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
         X_bin = np.empty((n, len(used_mappers)), dtype=dtype)
         _encode_bins(X, used_map, used_mappers, X_bin)
         return BinnedDataset(

@@ -163,3 +163,21 @@ def test_sparse_cache_densified_when_sparse_disabled(tmp_path):
     cached = BinnedDataset.from_file(p, Config(is_enable_sparse=False))
     assert not cached.is_sparse
     np.testing.assert_array_equal(cached.X_bin, ds.dense_bins())
+
+
+def test_u16_bin_ceiling_raises():
+    """>65536 bins per feature must raise (the reference's u32 dense-bin
+    specialization, bin.cpp:304-322, is deliberately not carried — the
+    record packs bins at u16 width), never silently wrap the u16 cast."""
+    import numpy as np
+    import pytest
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io import BinnedDataset, Metadata
+
+    n = 70_000
+    X = np.arange(n, dtype=np.float64).reshape(-1, 1)
+    cfg = Config(max_bin=70_000, bin_construct_sample_cnt=70_000)
+    with pytest.raises(ValueError, match="65536"):
+        BinnedDataset.from_matrix(
+            X, Metadata(label=np.zeros(n, np.float32)), config=cfg)
