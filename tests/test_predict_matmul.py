@@ -142,3 +142,23 @@ def test_inf_and_nan_routing():
     # the walk too: NaN <= t is false)
     leaves_walk = np.asarray(ensemble_leaves_raw(stacked, jnp.asarray(Xe)))
     np.testing.assert_array_equal(leaves_mm, leaves_walk)
+
+
+def test_row_chunked_predict(monkeypatch):
+    """The matmul path's row chunking (the 10M-rows OOM guard) must
+    produce identical results across chunk boundaries."""
+    X, y = _data(n=700)
+    bst = _train({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 5}, X, y)
+    from lightgbm_tpu.models import gbdt as gbdt_mod
+
+    monkeypatch.setattr(gbdt_mod, "_PREDICT_MM", "1")
+    gb = bst._gbdt if hasattr(bst, "_gbdt") else bst
+    one = bst.predict(X, raw_score=True)
+    leaves_one = bst.predict(X, pred_leaf=True)
+    monkeypatch.setattr(gbdt_mod, "_ROW_CHUNK", 256)  # 3 chunks of 700
+    chunked = bst.predict(X, raw_score=True)
+    leaves_chunked = bst.predict(X, pred_leaf=True)
+    np.testing.assert_array_equal(np.asarray(chunked), np.asarray(one))
+    np.testing.assert_array_equal(np.asarray(leaves_chunked),
+                                  np.asarray(leaves_one))
