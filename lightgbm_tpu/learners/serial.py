@@ -55,7 +55,7 @@ _FUSE_HIST_ENV = _os.environ.get("LGBM_TPU_FUSE_HIST", "1") != "0"
 # s/tree); interpret mode uses the bit-identical XLA fallback.
 _DIRECT_PLACE_ENV = _os.environ.get("LGBM_TPU_DIRECT_PLACE", "1") != "0"
 
-from ..models.tree import Tree, empty_tree
+from ..models.tree import Tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from ..ops.split import (
     SplitResult, find_best_split, find_best_split_leaves, K_MIN_SCORE)
@@ -84,40 +84,50 @@ class TreeLearnerParams(NamedTuple):
 
 
 class _GrowState(NamedTuple):
+    """Loop carry of the best-first growth.  All per-leaf scalar state is
+    PACKED into a few [rows, L] matrices so one split updates two
+    matrix COLUMNS instead of ~60 individual [L] arrays — the round-5
+    profile at the 100k/63-leaf shape showed HALF the device time was
+    per-op launch gaps from the unpacked representation's ~100 tiny
+    dynamic-slice/DUS/select ops per split."""
+
     order: jax.Array  # [n + max_cap] leaf-sorted row permutation (pad = n)
-    leaf_begin: jax.Array  # [L] int32 range start per leaf (order-space)
-    pos_cnt: jax.Array  # [L] int32 positional count per leaf (incl. OOB rows)
-    gate_cnt: jax.Array  # [L] int32 cross-shard MAX of pos_cnt (tier gates)
+    pos_mat: jax.Array  # [3, L] i32 rows: (leaf_begin, pos_cnt, gate_cnt)
     hists: jax.Array  # [L, F, B, 3] resident, or [P, F, B, 3] pooled
     slot_of: jax.Array  # [L] int32 pool slot per leaf, -1 = evicted ([0] off)
     slot_leaf: jax.Array  # [P] int32 leaf occupying each slot, -1 = free
     slot_last: jax.Array  # [P] int32 last-use step per slot, -1 = free
-    sum_g: jax.Array  # [L]
-    sum_h: jax.Array  # [L]
-    cnt: jax.Array  # [L]
-    best: SplitResult  # arrays of [L]
-    tree: Tree
+    best_mat: jax.Array  # [16, L] acc_dt — see _B* row constants
+    tree_i: jax.Array  # [5, L] i32 node table: feat, thr, dtype, lch, rch
+    tree_f: jax.Array  # [3, L] f32 node table: gain, int_value, int_count
+    nleaves: jax.Array  # scalar int32 used-leaf count
 
 
-def _empty_best(L: int, dtype=jnp.float32) -> SplitResult:
-    z = jnp.zeros(L, dtype)
-    return SplitResult(
-        gain=jnp.full(L, K_MIN_SCORE, dtype),
-        feature=jnp.full(L, -1, jnp.int32),
-        threshold=jnp.zeros(L, jnp.int32),
-        left_sum_grad=z,
-        left_sum_hess=z,
-        left_count=z,
-        right_sum_grad=z,
-        right_sum_hess=z,
-        right_count=z,
-        left_output=z,
-        right_output=z,
-    )
+# best_mat row indices.  Rows 0-10 are EXACTLY the Pallas search
+# kernels' packed [2, 16] result layout (ops/pallas_search._unpack), so
+# a kernel result row drops into a best_mat column unchanged; rows
+# 11-14 carry the per-leaf half of the Tree so the same two column
+# writes cover split state AND leaf bookkeeping.  Feature/threshold/
+# counts ride as floats — exact to 2^24, the same envelope the f32
+# kernel result already imposes.
+_BG, _BF, _BT = 0, 1, 2
+_BLSG, _BLSH, _BLC = 3, 4, 5
+_BRSG, _BRSH, _BRC = 6, 7, 8
+_BLO, _BRO = 9, 10
+_BLV, _BLCNT, _BLPAR, _BLDEP = 11, 12, 13, 14
+_BROWS = 16
 
 
-def _set_best(best: SplitResult, i, new: SplitResult) -> SplitResult:
-    return SplitResult(*[b.at[i].set(n) for b, n in zip(best, new)])
+def _sr_row(sr: SplitResult, dt):
+    """SplitResult -> kernel-result row layout [11(, L)]."""
+    return jnp.stack([
+        sr.gain.astype(dt), sr.feature.astype(dt), sr.threshold.astype(dt),
+        sr.left_sum_grad.astype(dt), sr.left_sum_hess.astype(dt),
+        sr.left_count.astype(dt),
+        sr.right_sum_grad.astype(dt), sr.right_sum_hess.astype(dt),
+        sr.right_count.astype(dt),
+        sr.left_output.astype(dt), sr.right_output.astype(dt),
+    ])
 
 
 def _round_up(x: int, m: int) -> int:
@@ -300,7 +310,7 @@ def grow_tree(
       reduces the two children's LOCAL positional counts once — the sums
       pick the globally smaller child (whose histogram partials the mesh
       reduces), the maxes feed the static-capacity tier gates of BOTH
-      later splits of these leaves (stored in ``state.gate_cnt``, so no
+      later splits of these leaves (stored in ``pos_mat`` row 2, so no
       per-split pmax is needed at consume time).  Default: local values
       through ``reduce_fn``/``reduce_max_fn`` when given, else identity.
     * ``search2_fn(h_left, h_right, lsg, lsh, lc, rsg, rsh, rc, can,
@@ -458,7 +468,6 @@ def grow_tree(
         from ..ops.pallas_search import (
             _pack_meta as _search_pack_meta,
             _pack_scal as _search_pack_scal,
-            _unpack as _search_unpack,
         )
         # mega split-step kernel (ops/record.py split_step_window):
         # compaction + LEFT-child histogram + both searches + in-place
@@ -579,25 +588,55 @@ def grow_tree(
                 params.lambda_l1, params.lambda_l2, params.min_gain_to_split,
                 can0,
             )
+        _pad1 = lambda a: jnp.concatenate(  # noqa: E731
+            [a, jnp.zeros(1, a.dtype)])
         state = _GrowState(
             order=jnp.concatenate(
                 [order0, jnp.full(order_pad, n, jnp.int32)]
             ),
-            leaf_begin=begin0,
-            pos_cnt=counts,
-            gate_cnt=gate0,
+            pos_mat=jnp.stack([begin0, counts, gate0]),
             hists=fused,
             slot_of=jnp.zeros(0, jnp.int32),
             slot_leaf=jnp.zeros(0, jnp.int32),
             slot_last=jnp.zeros(0, jnp.int32),
-            sum_g=leaf_tot[:, 0],
-            sum_h=leaf_tot[:, 1],
-            cnt=leaf_tot[:, 2],
-            best=best0,
-            tree=init_tree,
+            best_mat=jnp.concatenate([
+                _sr_row(best0, acc_dt),
+                init_tree.leaf_value[None].astype(acc_dt),
+                init_tree.leaf_count[None].astype(acc_dt),
+                init_tree.leaf_parent[None].astype(acc_dt),
+                init_tree.leaf_depth[None].astype(acc_dt),
+                jnp.zeros((_BROWS - 15, L), acc_dt),
+            ]),
+            tree_i=jnp.stack([
+                _pad1(init_tree.split_feature),
+                _pad1(init_tree.threshold_bin),
+                _pad1(init_tree.decision_type),
+                _pad1(init_tree.left_child),
+                _pad1(init_tree.right_child),
+            ]),
+            tree_f=jnp.stack([
+                _pad1(init_tree.split_gain),
+                _pad1(init_tree.internal_value),
+                _pad1(init_tree.internal_count),
+            ]),
+            nleaves=K0,
         )
         start_step = K0 - 1
     else:
+        root_best = best_for(
+            # raw-layout root histogram -> canonical view for the
+            # (once-per-tree) jnp root search
+            hist0[:F, :3, :num_bins].transpose(0, 2, 1) if opt else hist0,
+            sum_g0, sum_h0, cnt0, jnp.int32(0),
+        )
+        best_mat0 = (
+            jnp.zeros((_BROWS, L), acc_dt)
+            .at[_BG].set(K_MIN_SCORE)
+            .at[_BF].set(-1.0)
+            .at[_BLPAR].set(-1.0)  # empty_tree's leaf_parent = -1
+        )
+        best_mat0 = jax.lax.dynamic_update_slice(
+            best_mat0, _sr_row(root_best, acc_dt)[:, None], (0, 0))
         state = _GrowState(
             # record mode: the "order" leaf carries the [W, n_pad]
             # packed record; otherwise the flat row permutation
@@ -612,10 +651,10 @@ def grow_tree(
                     jnp.full(order_pad, n, jnp.int32),
                 ]
             ),
-            leaf_begin=jnp.zeros(L, jnp.int32),
-            pos_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
-            # root gate: every shard's padded local row count is the same n
-            gate_cnt=jnp.zeros(L, jnp.int32).at[0].set(n),
+            # root gate: every shard's padded local row count is the
+            # same n (rows: leaf_begin, pos_cnt, gate_cnt)
+            pos_mat=jnp.zeros((3, L), jnp.int32)
+            .at[1, 0].set(n).at[2, 0].set(n),
             hists=jnp.zeros((P,) + hist0.shape, acc_dt).at[0].set(hist0),
             slot_of=(jnp.full(L, -1, jnp.int32).at[0].set(0) if pooled
                      else jnp.zeros(0, jnp.int32)),
@@ -623,21 +662,10 @@ def grow_tree(
                        else jnp.zeros(0, jnp.int32)),
             slot_last=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
                        else jnp.zeros(0, jnp.int32)),
-            sum_g=jnp.zeros(L, acc_dt).at[0].set(sum_g0),
-            sum_h=jnp.zeros(L, acc_dt).at[0].set(sum_h0),
-            cnt=jnp.zeros(L, acc_dt).at[0].set(cnt0),
-            best=_set_best(
-                _empty_best(L, acc_dt),
-                0,
-                best_for(
-                    # raw-layout root histogram -> canonical view for
-                    # the (once-per-tree) jnp root search
-                    hist0[:F, :3, :num_bins].transpose(0, 2, 1)
-                    if opt else hist0,
-                    sum_g0, sum_h0, cnt0, jnp.int32(0),
-                ),
-            ),
-            tree=empty_tree(L),
+            best_mat=best_mat0,
+            tree_i=jnp.zeros((5, L), jnp.int32).at[0].set(-1),
+            tree_f=jnp.zeros((3, L), jnp.float32),
+            nleaves=jnp.int32(1),
         )
         start_step = 0
 
@@ -649,20 +677,36 @@ def grow_tree(
         [L, F, B, 3] histogram buffer every iteration (O(L^2*F*B) traffic
         per tree), which dominated the run time.  Masked straight-line
         writes keep every buffer update in place."""
-        t = state.tree
         node = step
         new_leaf = step + 1
 
-        f = state.best.feature[best_leaf]
-        thr = state.best.threshold[best_leaf]
+        # ---- ALL per-leaf scalar reads come from four column slices
+        # (parent + prospective-new-leaf columns of the two packed
+        # matrices) instead of ~40 individual [L]-array gathers.
+        z0 = jnp.int32(0)
+        bcol = jax.lax.dynamic_slice(
+            state.best_mat, (z0, best_leaf), (_BROWS, 1))[:, 0]
+        bcolN = jax.lax.dynamic_slice(
+            state.best_mat, (z0, new_leaf), (_BROWS, 1))[:, 0]
+        pcol = jax.lax.dynamic_slice(
+            state.pos_mat, (z0, best_leaf), (3, 1))[:, 0]
+        pcolN = jax.lax.dynamic_slice(
+            state.pos_mat, (z0, new_leaf), (3, 1))[:, 0]
+
+        f = bcol[_BF].astype(jnp.int32)
+        thr = bcol[_BT].astype(jnp.int32)
         is_cat = is_categorical[jnp.maximum(f, 0)]
+        lsg, lsh, lc = bcol[_BLSG], bcol[_BLSH], bcol[_BLC]
+        rsg, rsh, rc = bcol[_BRSG], bcol[_BRSH], bcol[_BRC]
+        depth_child = bcol[_BLDEP].astype(jnp.int32) + 1
 
         # ---- partition the parent's range in place (DataPartition::Split).
         # The tier gate (cross-shard max of the parent's positional count)
         # was stored at the split that CREATED this leaf — no collective
         # here.
-        begin = state.leaf_begin[best_leaf]
-        pcnt = state.pos_cnt[best_leaf]
+        begin = pcol[0]
+        pcnt = pcol[1]
+        gate = pcol[2]
         mega_res = None
         if opt_fused and fuse_hist:
             # MEGA split step: compaction + left-child histogram + both
@@ -671,15 +715,10 @@ def grow_tree(
             # dispatch, not op work).  depth gate + per-split scalars
             # for the in-kernel search:
             can_k = (params.max_depth <= 0) | (
-                t.leaf_depth[best_leaf] + 1 < params.max_depth)
+                depth_child < params.max_depth)
             scal_f = _search_pack_scal(
                 can_k.astype(jnp.float32),
-                state.best.left_sum_grad[best_leaf],
-                state.best.left_sum_hess[best_leaf],
-                state.best.left_count[best_leaf],
-                state.best.right_sum_grad[best_leaf],
-                state.best.right_sum_hess[best_leaf],
-                state.best.right_count[best_leaf],
+                lsg, lsh, lc, rsg, rsh, rc,
                 params.min_data_in_leaf, params.min_sum_hessian_in_leaf,
                 params.lambda_l1, params.lambda_l2,
                 params.min_gain_to_split,
@@ -710,7 +749,7 @@ def grow_tree(
                 return mh, rec2, nl, res
 
             mega_hists, order, nleft, mega_res = _tier_chain(
-                p_tiers, state.gate_cnt[best_leaf], _mega_rec
+                p_tiers, gate, _mega_rec
             )
         elif rec:
 
@@ -724,35 +763,17 @@ def grow_tree(
                     interpret=_interp,
                 )
 
-            order, nleft = _tier_chain(
-                p_tiers, state.gate_cnt[best_leaf], _part_rec
-            )
+            order, nleft = _tier_chain(p_tiers, gate, _part_rec)
         else:
             order, nleft = _tier_chain(
                 p_tiers,
-                state.gate_cnt[best_leaf],
+                gate,
                 lambda cap: _partition_branch(
                     state.order, bins_T, f, thr, is_cat, begin, pcnt,
                     do_split, cap
                 ),
             )
         nright = pcnt - nleft
-        leaf_begin = state.leaf_begin.at[new_leaf].set(
-            jnp.where(do_split, begin + nleft, state.leaf_begin[new_leaf])
-        )
-        pos_cnt = (
-            state.pos_cnt.at[best_leaf]
-            .set(jnp.where(do_split, nleft, pcnt))
-            .at[new_leaf]
-            .set(jnp.where(do_split, nright, state.pos_cnt[new_leaf]))
-        )
-
-        lsg = state.best.left_sum_grad[best_leaf]
-        lsh = state.best.left_sum_hess[best_leaf]
-        lc = state.best.left_count[best_leaf]
-        rsg = state.best.right_sum_grad[best_leaf]
-        rsh = state.best.right_sum_hess[best_leaf]
-        rc = state.best.right_count[best_leaf]
 
         # ---- smaller-child histogram from its contiguous range; sibling
         # by subtraction.  "Smaller" is by POSITIONAL count (the work the
@@ -764,12 +785,6 @@ def grow_tree(
         # split's histogram AND both children's later partitions).
         nleft_g, nright_g, nleft_gate, nright_gate = child_counts_fn(
             nleft, nright
-        )
-        gate_cnt = (
-            state.gate_cnt.at[best_leaf]
-            .set(jnp.where(do_split, nleft_gate, state.gate_cnt[best_leaf]))
-            .at[new_leaf]
-            .set(jnp.where(do_split, nright_gate, state.gate_cnt[new_leaf]))
         )
         small_is_left = nleft_g <= nright_g
         cnt_s = jnp.where(small_is_left, nleft, nright)
@@ -820,7 +835,7 @@ def grow_tree(
                 lambda _: state.hists[jnp.maximum(ps, 0)],
                 lambda _: _tier_chain(
                     h_tiers,
-                    state.gate_cnt[best_leaf],
+                    gate,
                     lambda cap: _child_hist_branch(
                         hist_fn, order, bins_T, grad, hess, bag_mask,
                         begin, pcnt, cap,
@@ -843,12 +858,12 @@ def grow_tree(
         else:
             h_parent = None if opt_fused else state.hists[best_leaf]
             h_prev_new = None if opt_fused else state.hists[new_leaf]
-        depth_child = t.leaf_depth[best_leaf] + 1
         if mega_res is not None:
             # mega path: results come straight out of split_step_window
+            # ALREADY in the best_mat row layout — no unpack/repack
             hists = mega_hists
-            best_l_new = _search_unpack(mega_res, 0)
-            best_r_new = _search_unpack(mega_res, 1)
+            rowL = mega_res[0, :11].astype(bcol.dtype)
+            rowR = mega_res[1, :11].astype(bcol.dtype)
         elif opt_fused:
             # ---- ONE launch: subtract + child routing + both searches
             # + in-place buffer row updates (ops/pallas_search.py
@@ -869,6 +884,8 @@ def grow_tree(
                 params.min_gain_to_split,
                 interpret=_interp,
             )
+            rowL = _sr_row(best_l_new, bcol.dtype)
+            rowR = _sr_row(best_r_new, bcol.dtype)
         else:
             h_large = h_parent - h_small
             h_left = jnp.where(small_is_left, h_small, h_large)
@@ -912,6 +929,8 @@ def grow_tree(
                 )
             )
             hists = hists_in.at[rows_idx].set(new_rows, unique_indices=True)
+            rowL = _sr_row(best_l_new, bcol.dtype)
+            rowR = _sr_row(best_r_new, bcol.dtype)
 
         if pooled:
             # residency bookkeeping, all masked on do_split: evicted
@@ -936,92 +955,119 @@ def grow_tree(
             slot_leaf = state.slot_leaf
             slot_last = state.slot_last
 
-        # ---- tree bookkeeping (Tree::Split, tree.cpp:52-96)
-        parent = t.leaf_parent[best_leaf]
+        # ---- packed column updates: per-leaf split state + the leaf
+        # half of the tree ride best_mat (two column writes); partition
+        # ranges ride pos_mat (two column writes); the node half of the
+        # tree rides tree_i/tree_f (three column read-modify-writes).
+        dt = bcol.dtype
+        node_f = node.astype(dt)
+        dep_f = depth_child.astype(dt)
+        zero = jnp.zeros((), dt)
+        tailL = jnp.stack([bcol[_BLO], lc, node_f, dep_f, zero])
+        tailR = jnp.stack([bcol[_BRO], rc, node_f, dep_f, zero])
+        colL = jnp.where(do_split, jnp.concatenate([rowL, tailL]), bcol)
+        colR = jnp.where(do_split, jnp.concatenate([rowR, tailR]), bcolN)
+        best_mat = jax.lax.dynamic_update_slice(
+            state.best_mat, colL[:, None], (z0, best_leaf))
+        best_mat = jax.lax.dynamic_update_slice(
+            best_mat, colR[:, None], (z0, new_leaf))
+
+        pcL = jnp.where(do_split, jnp.stack([begin, nleft, nleft_gate]), pcol)
+        pcR = jnp.where(
+            do_split, jnp.stack([begin + nleft, nright, nright_gate]), pcolN)
+        pos_mat = jax.lax.dynamic_update_slice(
+            state.pos_mat, pcL[:, None], (z0, best_leaf))
+        pos_mat = jax.lax.dynamic_update_slice(
+            pos_mat, pcR[:, None], (z0, new_leaf))
+
+        # ---- tree bookkeeping (Tree::Split, tree.cpp:52-96): fix up the
+        # parent's child pointer (the split leaf keeps its node id ~leaf
+        # until it becomes internal node ``node``), then write the new
+        # node's column.  pidx < node always, so the two writes never
+        # collide.
+        parent = bcol[_BLPAR].astype(jnp.int32)
         has_parent = parent >= 0
         pidx = jnp.maximum(parent, 0)
-        was_left = t.left_child[pidx] == ~best_leaf
-        left_child = t.left_child.at[pidx].set(
-            jnp.where(do_split & has_parent & was_left, node, t.left_child[pidx])
+        colP = jax.lax.dynamic_slice(state.tree_i, (z0, pidx), (5, 1))[:, 0]
+        was_left = colP[3] == ~best_leaf
+        colP = colP.at[3].set(
+            jnp.where(do_split & has_parent & was_left, node, colP[3]))
+        colP = colP.at[4].set(
+            jnp.where(do_split & has_parent & ~was_left, node, colP[4]))
+        tree_i = jax.lax.dynamic_update_slice(
+            state.tree_i, colP[:, None], (z0, pidx))
+        colNd = jax.lax.dynamic_slice(tree_i, (z0, node), (5, 1))[:, 0]
+        colNd = jnp.where(
+            do_split,
+            jnp.stack(
+                [f, thr, is_cat.astype(jnp.int32), ~best_leaf, ~new_leaf]),
+            colNd,
         )
-        right_child = t.right_child.at[pidx].set(
-            jnp.where(do_split & has_parent & ~was_left, node, t.right_child[pidx])
-        )
-        left_child = left_child.at[node].set(
-            jnp.where(do_split, ~best_leaf, left_child[node])
-        )
-        right_child = right_child.at[node].set(
-            jnp.where(do_split, ~new_leaf, right_child[node])
-        )
+        tree_i = jax.lax.dynamic_update_slice(
+            tree_i, colNd[:, None], (z0, node))
 
-        def m(arr, i, val):  # masked store: keep old value unless splitting
+        colTf = jax.lax.dynamic_slice(state.tree_f, (z0, node), (3, 1))[:, 0]
+        colTf = jnp.where(
+            do_split,
             # cast explicitly: under hist_dtype=float64 the split stats
             # are f64 while tree buffers stay f32
-            return arr.at[i].set(
-                jnp.where(do_split, val, arr[i]).astype(arr.dtype)
-            )
-
-        tree = t._replace(
-            num_leaves=t.num_leaves + do_split.astype(t.num_leaves.dtype),
-            split_feature=m(t.split_feature, node, f),
-            threshold_bin=m(t.threshold_bin, node, thr),
-            decision_type=m(t.decision_type, node, is_cat.astype(jnp.int32)),
-            left_child=left_child,
-            right_child=right_child,
-            split_gain=m(t.split_gain, node, state.best.gain[best_leaf]),
-            internal_value=m(t.internal_value, node, t.leaf_value[best_leaf]),
-            internal_count=m(t.internal_count, node, lc + rc),
-            leaf_value=m(
-                m(t.leaf_value, best_leaf, state.best.left_output[best_leaf]),
-                new_leaf,
-                state.best.right_output[best_leaf],
-            ),
-            leaf_count=m(m(t.leaf_count, best_leaf, lc), new_leaf, rc),
-            leaf_parent=m(m(t.leaf_parent, best_leaf, node), new_leaf, node),
-            leaf_depth=m(
-                m(t.leaf_depth, best_leaf, depth_child), new_leaf, depth_child
-            ),
+            jnp.stack([bcol[_BG], bcol[_BLV], lc + rc]).astype(jnp.float32),
+            colTf,
         )
-
-        best_l, best_r = best_l_new, best_r_new
-        old_l = SplitResult(*[b[best_leaf] for b in state.best])
-        old_r = SplitResult(*[b[new_leaf] for b in state.best])
-        best_l = SplitResult(
-            *[jnp.where(do_split, nv, ov) for nv, ov in zip(best_l, old_l)]
-        )
-        best_r = SplitResult(
-            *[jnp.where(do_split, nv, ov) for nv, ov in zip(best_r, old_r)]
-        )
-        best = _set_best(_set_best(state.best, best_leaf, best_l), new_leaf, best_r)
+        tree_f = jax.lax.dynamic_update_slice(
+            state.tree_f, colTf[:, None], (z0, node))
 
         return _GrowState(
             order=order,
-            leaf_begin=leaf_begin,
-            pos_cnt=pos_cnt,
-            gate_cnt=gate_cnt,
+            pos_mat=pos_mat,
             hists=hists,
             slot_of=slot_of,
             slot_leaf=slot_leaf,
             slot_last=slot_last,
-            sum_g=m(m(state.sum_g, best_leaf, lsg), new_leaf, rsg),
-            sum_h=m(m(state.sum_h, best_leaf, lsh), new_leaf, rsh),
-            cnt=m(m(state.cnt, best_leaf, lc), new_leaf, rc),
-            best=best,
-            tree=tree,
+            best_mat=best_mat,
+            tree_i=tree_i,
+            tree_f=tree_f,
+            nleaves=state.nleaves + do_split.astype(jnp.int32),
         )
 
     def body(step, state):
-        best_leaf = jnp.argmax(state.best.gain).astype(jnp.int32)
-        do_split = state.best.gain[best_leaf] > 0.0
+        gain_row = state.best_mat[_BG]
+        best_leaf = jnp.argmax(gain_row).astype(jnp.int32)
+        do_split = gain_row[best_leaf] > 0.0
         return split_branch(state, jnp.int32(step), best_leaf, do_split)
 
     state = jax.lax.fori_loop(start_step, L - 1, body, state)
+
+    # ---- unpack the Tree pytree from the packed node/leaf tables (one
+    # set of static row slices per TREE, replacing the ~30 per-SPLIT
+    # masked stores of the unpacked representation)
+    li = L - 1
+    tree = Tree(
+        num_leaves=state.nleaves,
+        split_feature=state.tree_i[0, :li],
+        split_feature_real=(
+            init_tree.split_feature_real if init_tree is not None
+            else jnp.full(li, -1, jnp.int32)),
+        threshold_bin=state.tree_i[1, :li],
+        threshold_real=(
+            init_tree.threshold_real if init_tree is not None
+            else jnp.zeros(li, jnp.float32)),
+        decision_type=state.tree_i[2, :li],
+        left_child=state.tree_i[3, :li],
+        right_child=state.tree_i[4, :li],
+        split_gain=state.tree_f[0, :li],
+        internal_value=state.tree_f[1, :li],
+        internal_count=state.tree_f[2, :li],
+        leaf_value=state.best_mat[_BLV].astype(jnp.float32),
+        leaf_count=state.best_mat[_BLCNT].astype(jnp.float32),
+        leaf_parent=state.best_mat[_BLPAR].astype(jnp.int32),
+        leaf_depth=state.best_mat[_BLDEP].astype(jnp.int32),
+    )
 
     # ---- per-row leaf assignment from the final ranges: leaves own
     # disjoint contiguous [begin, begin+count) spans of ``order``, so the
     # leaf of a position is a searchsorted over the (few) sorted begins,
     # then one unique-index scatter maps positions back to rows.
-    tree = state.tree
     if rec:
         # record mode: the partition stamped every position's leaf id
         # into the record's leaf-id row — one contiguous read replaces
@@ -1031,9 +1077,9 @@ def grow_tree(
         rows = jnp.minimum(state.order[_row_id_row, :n], n - 1)
     else:
         idxL = jnp.arange(L, dtype=jnp.int32)
-        valid_leaf = (idxL < tree.num_leaves) & (state.pos_cnt > 0)
+        valid_leaf = (idxL < tree.num_leaves) & (state.pos_mat[1] > 0)
         key = jnp.where(
-            valid_leaf, state.leaf_begin, jnp.int32(n + order_pad))
+            valid_leaf, state.pos_mat[0], jnp.int32(n + order_pad))
         perm = jnp.argsort(key).astype(jnp.int32)
         sb = key[perm]
         leaf_of_pos = perm[

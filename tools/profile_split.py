@@ -46,9 +46,22 @@ def main():
     from lightgbm_tpu.objectives import create_objective
 
     print("devices:", jax.devices(), flush=True)
-    X, y = bench.make_data(ROWS)
-    cfg = Config(objective="binary", num_leaves=255, max_bin=255,
+    leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    cat_cols = ()
+    if os.environ.get("BENCH_CAT"):
+        # the bench_categorical.py 100k Expo shape: 4 numeric + 4
+        # categorical columns, 63 leaves — the small-shape floor case
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_categorical as bc
+        Xn, Xc, y = bc.make_data(ROWS)
+        X = np.column_stack([Xn, Xc])
+        cat_cols = tuple(range(Xn.shape[1], X.shape[1]))
+        leaves = int(os.environ.get("BENCH_LEAVES", bc.LEAVES))
+    else:
+        X, y = bench.make_data(ROWS)
+    cfg = Config(objective="binary", num_leaves=leaves, max_bin=255,
                  learning_rate=0.1, min_data_in_leaf=100, metric=["auc"],
+                 categorical_column=",".join(map(str, cat_cols)),
                  tree_growth=os.environ.get("BENCH_GROWTH", "leafwise"))
     ds = BinnedDataset.from_matrix(
         X, Metadata(label=y.astype(np.float32)), config=cfg)
