@@ -1,0 +1,55 @@
+"""Version-portability shims for JAX APIs that moved between releases.
+
+The training code targets the current public spellings
+(``jax.shard_map`` with ``check_vma=``, ``jax.enable_x64``); older
+jaxlibs (e.g. the 0.4.x line this container ships) only have the
+``jax.experimental`` spellings (``shard_map`` with ``check_rep=``,
+``experimental.enable_x64``).  Without the shim every mesh learner and
+every f64-accumulating metric died with AttributeError on 0.4.x —
+27 of the 30 seed tier-1 failures.
+
+Imports of jax stay inside the functions: importing this module must
+not trigger backend registration (bench.py probes backend liveness in
+a subprocess BEFORE letting the axon plugin dial the TPU tunnel).
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` where available, else the experimental one.
+
+    The replication-check kwarg was renamed ``check_rep`` ->
+    ``check_vma`` across versions, on BOTH spellings' APIs (mid-range
+    releases expose top-level ``jax.shard_map`` still taking
+    ``check_rep``), so the translation is driven by the TypeError, not
+    by which import resolved."""
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is None:
+        from jax.experimental.shard_map import shard_map as native
+    try:
+        return native(f, **kwargs)
+    except TypeError:
+        flipped = dict(kwargs)
+        if "check_vma" in flipped:
+            flipped["check_rep"] = flipped.pop("check_vma")
+        elif "check_rep" in flipped:
+            flipped["check_vma"] = flipped.pop("check_rep")
+        else:
+            raise
+        return native(f, **flipped)
+
+
+def enable_x64(enabled: bool = True):
+    """``jax.enable_x64`` where available, else the experimental
+    context manager."""
+    import jax
+
+    try:
+        return jax.enable_x64(enabled)
+    except AttributeError:
+        from jax.experimental import enable_x64 as _e64
+
+        return _e64(enabled)

@@ -49,7 +49,9 @@ class Metric:
         increments drop below f32 spacing entirely)."""
         import jax
 
-        with jax.enable_x64(True):
+        from .compat import enable_x64
+
+        with enable_x64(True):
             if self._jfn is None:
                 self._jfn = jax.jit(self.eval_jax)
             return self._jfn(scores)

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compat import enable_x64
 from ..config import Config
 from ..io.dataset import BinnedDataset
 from ..learners.serial import TreeLearnerParams, grow_tree
@@ -493,7 +494,7 @@ class GBDT:
         for k in range(K):
             fmask = self._sample_features()
             if self._use_f64_hist:
-                with jax.enable_x64(True):
+                with enable_x64(True):
                     gk = grad[k].astype(jnp.float64)
                     hk = hess[k].astype(jnp.float64)
                     tree, leaf_id = self._grow(

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map
 from ..learners.depthwise import grow_tree_depthwise
 from ..learners.hybrid import HYBRID_STOP_FACTOR
 from ..learners.serial import grow_tree
@@ -258,7 +259,7 @@ def data_parallel_sharded(
             record_mode=record,
         )
 
-    return jax.shard_map(
+    return shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(None, axis), P(axis), P(axis), P(axis), P(), P(), P(), P()),

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map
 from ..learners.serial import grow_tree
 from ..ops.histogram import histogram_feature_major
 from ..ops.split import find_best_split
@@ -94,7 +95,7 @@ def make_voting_parallel_grower(
             record_mode=True,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(None, axis), P(axis), P(axis), P(axis), P(), P(), P(), P()),
