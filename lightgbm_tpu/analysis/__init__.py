@@ -1,0 +1,37 @@
+"""jaxlint: JAX-aware static analysis + compiled-artifact audit.
+
+Two stages, one failure class: perf regressions that are invisible at
+unit-test level on this stack — silent full-record copies at cond
+boundaries, dropped buffer donation, dtype promotion, host syncs inside
+hot loops, and lazy recompiles polluting timed loops (the round-5
+`learners/serial.py` rework shipped exactly such a regression
+unmeasured; ROADMAP "Recent").
+
+* Stage 1 (``ast_rules``): pure-AST lint over ``lightgbm_tpu/`` — no
+  JAX import, runs in milliseconds.
+* Stage 2 (``hlo_audit``): trace/lower/compile the hot entry points on
+  CPU and assert committed budgets (``analysis/budgets.json``) on HLO
+  op counts, donation aliasing, and the single-mention aliased record
+  chain; ``recompile`` provides the process-wide backend-compile
+  counter the bench warm-up and the steady-loop gate use.
+
+Both stages are wired into tier-1 (tests/test_jaxlint.py,
+tests/test_hlo_budgets.py) and the standalone ``tools/jaxlint.py`` CLI.
+"""
+
+from .ast_rules import (  # noqa: F401
+    AST_RULES,
+    Finding,
+    lint_paths,
+    lint_source,
+)
+from .hlo_audit import (  # noqa: F401
+    ARTIFACT_RULES,
+    audit_artifacts,
+    budgets_path,
+    check_budgets,
+    hlo_op_counts,
+    load_budgets,
+    measure_entry_points,
+)
+from .recompile import CompileCounter, compile_counter  # noqa: F401

@@ -1,0 +1,82 @@
+"""Process-wide backend-compile counter.
+
+JAX emits a ``/jax/core/compile/backend_compile_duration`` monitoring
+event once per actual backend compile (cache hits emit nothing —
+verified on this jaxlib: two same-shape calls add zero events, a new
+shape adds one).  Counting these events gives the recompile signal the
+bench warm-up and the steady-loop tier-1 gate need: a timed loop is
+only honest once an iteration adds no new compiles.
+
+The listener registry in jax.monitoring has no targeted unregister, so
+the listener installs once per process and stays; the counter is read
+by delta (``CompileCounter.delta()`` snapshots).
+
+Caveat: lazily-compiled Mosaic kernels inside an already-compiled XLA
+program (the per-tier TPU kernels) compile in the TPU runtime and do
+NOT emit this event — callers that warm real-chip loops should combine
+the counter with an iteration-time stability check (bench.py does).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:  # noqa: ARG001
+    global _count
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _count += 1
+
+
+def _install() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        # flag is set only AFTER successful registration: a failure
+        # must surface on the next call too, not leave a permanently-
+        # zero counter that makes every compile-stability gate pass
+        # vacuously (registration never fires the listener, so holding
+        # _lock across it cannot deadlock)
+        _installed = True
+
+
+class CompileCounter:
+    """Snapshot view over the process-wide compile count."""
+
+    def __init__(self) -> None:
+        _install()
+        self._mark = backend_compile_count()
+
+    @property
+    def count(self) -> int:
+        """Total backend compiles this process has performed."""
+        return backend_compile_count()
+
+    def delta(self) -> int:
+        """Compiles since construction or the last ``reset()``."""
+        return backend_compile_count() - self._mark
+
+    def reset(self) -> None:
+        self._mark = backend_compile_count()
+
+
+def backend_compile_count() -> int:
+    _install()
+    with _lock:
+        return _count
+
+
+def compile_counter() -> CompileCounter:
+    """A fresh zeroed snapshot counter (installs the listener)."""
+    return CompileCounter()

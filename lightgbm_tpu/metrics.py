@@ -20,6 +20,11 @@ from typing import List, Optional
 
 import numpy as np
 
+# jaxlint: disable-file=f64-literal-in-traced — the eval_jax reductions
+# deliberately accumulate in f64 under the enable_x64 context installed
+# by eval_jax_jit (f32 cumsums drift in the 4th AUC decimal at ~10M
+# rows; with >2^24 unit-weight rows the increments vanish entirely).
+
 _EPS = 1e-15
 
 

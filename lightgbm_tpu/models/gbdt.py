@@ -709,7 +709,9 @@ class GBDT:
                     tpart = jax.tree.map(lambda a: a[lo:lo + step], tables)
                     out = ensemble_sum_matmul(tpart, part, Xc)
                     acc = out if acc is None else acc + out
-                parts.append(np.asarray(acc, np.float64))
+                # per-chunk materialization IS the product here (the
+                # chunking exists to bound device memory)
+                parts.append(np.asarray(acc, np.float64))  # jaxlint: disable=host-sync-in-loop
             return np.concatenate(parts, axis=1)
         step = self._iter_chunk(X.shape[0])
         acc = None
@@ -757,14 +759,16 @@ class GBDT:
                 for lo in range(0, n_iter * K, step):
                     part = jax.tree.map(lambda a: a[lo:lo + step], stacked)
                     tpart = jax.tree.map(lambda a: a[lo:lo + step], tables)
-                    outs.append(np.asarray(
+                    # chunked materialization bounds device memory
+                    outs.append(np.asarray(  # jaxlint: disable=host-sync-in-loop
                         ensemble_leaves_matmul(tpart, part, Xc)))
                 parts.append(np.concatenate(outs, axis=0))
             return np.concatenate(parts, axis=1).T
         outs = []
         for lo in range(0, n_iter * K, step):
             part = jax.tree.map(lambda a: a[lo:lo + step], stacked)
-            outs.append(np.asarray(ensemble_leaves_raw(part, X)))
+            # chunked materialization bounds device memory
+            outs.append(np.asarray(ensemble_leaves_raw(part, X)))  # jaxlint: disable=host-sync-in-loop
         return np.concatenate(outs, axis=0).T
 
     def objective_name(self) -> str:
@@ -971,10 +975,11 @@ class GBDT:
     def feature_importance_array(self, importance_type: str = "split") -> np.ndarray:
         """Importances as an array over all original columns."""
         imp = np.zeros(self.max_feature_idx + 1, np.float64)
+        # cold path (model save/dump), inherently host-side per tree
         for tree in self.models:
             nl = int(tree.num_leaves)
-            sfr = np.asarray(tree.split_feature_real)[: nl - 1]
-            gains = np.asarray(tree.split_gain)[: nl - 1]
+            sfr = np.asarray(tree.split_feature_real)[: nl - 1]  # jaxlint: disable=host-sync-in-loop
+            gains = np.asarray(tree.split_gain)[: nl - 1]  # jaxlint: disable=host-sync-in-loop
             for j, f in enumerate(sfr):
                 if f >= 0:
                     imp[f] += gains[j] if importance_type == "gain" else 1
