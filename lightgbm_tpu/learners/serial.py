@@ -54,6 +54,13 @@ _FUSE_HIST_ENV = _os.environ.get("LGBM_TPU_FUSE_HIST", "1") != "0"
 # Chip-validated by tools/tpu_parity_check.py (1M: 0.473 -> 0.399
 # s/tree); interpret mode uses the bit-identical XLA fallback.
 _DIRECT_PLACE_ENV = _os.environ.get("LGBM_TPU_DIRECT_PLACE", "1") != "0"
+# geometric step between hist/partition tier capacities (see
+# _hist_tiers); read ONCE at import like every other kernel knob — a
+# trace-time read bakes the value per trace while the jit cache keys
+# only on static args, so a mid-process env flip silently applied to
+# SOME shapes and not others (jaxlint env-read-at-trace)
+_TIER_SPACING_ENV = max(
+    2, int(_os.environ.get("LGBM_TPU_TIER_SPACING", "2")))
 
 from ..models.tree import Tree
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
@@ -141,17 +148,15 @@ def _hist_tiers(n: int):
     n_local (global balance says nothing about one shard's split), so
     ceil(n/2) is not a guaranteed fit there.
 
-    LGBM_TPU_TIER_SPACING (read at TRACE time; default 2) sets the
-    geometric step between capacities: 2 wastes <2x gather work per
-    split but instantiates ~9 tier bodies (one Mosaic kernel compile
-    each on TPU); 4 halves the tier count for <4x gather waste.
-    Measured XLA:CPU compile at n=1M, L=255, B=255 (segment hist):
-    spacing=2 (9 tiers) 9.5s, spacing=4 (5 tiers) 13.8s — tier count is
-    NOT the compile bottleneck off-TPU; the knob exists for the Mosaic
-    per-kernel compile path."""
-    import os
-
-    step = max(2, int(os.environ.get("LGBM_TPU_TIER_SPACING", "2")))
+    LGBM_TPU_TIER_SPACING (read ONCE at import, see _TIER_SPACING_ENV;
+    default 2) sets the geometric step between capacities: 2 wastes
+    <2x gather work per split but instantiates ~9 tier bodies (one
+    Mosaic kernel compile each on TPU); 4 halves the tier count for
+    <4x gather waste.  Measured XLA:CPU compile at n=1M, L=255, B=255
+    (segment hist): spacing=2 (9 tiers) 9.5s, spacing=4 (5 tiers)
+    13.8s — tier count is NOT the compile bottleneck off-TPU; the knob
+    exists for the Mosaic per-kernel compile path."""
+    step = _TIER_SPACING_ENV
     caps = {max(512, _round_up(n, 128))}
     frac = 2
     while frac <= 256:  # step=2 reproduces the original 2,4,...,256 set

@@ -55,10 +55,16 @@ FGROUP_BSUB = 16
 _VARIANTS = ("v1", "bsub")
 
 
+# read ONCE at import (jaxlint env-read-at-trace): _kernel_variant is
+# called from inside jitted histogram fns, where an environ read bakes
+# per trace while the jit cache keys only on static args
+_VARIANT_ENV = os.environ.get("LGBM_TPU_HIST_KERNEL", "v1")
+
+
 def _kernel_variant(variant: str | None = None) -> str:
     # default stays on the chip-proven v1 until bsub has a real Mosaic
     # compile + timing on TPU hardware (tunnel down at authoring time)
-    v = variant or os.environ.get("LGBM_TPU_HIST_KERNEL", "v1")
+    v = variant or _VARIANT_ENV
     if v not in _VARIANTS:
         raise ValueError(
             f"unknown histogram kernel variant {v!r}; expected one of {_VARIANTS}"

@@ -6,9 +6,10 @@ Times, at bench shapes (F=28, B=255, L=255):
   1. sorted level kernel, v1 vs bsub
   2. single-leaf kernel (n/4 and n/16 rows), v1 vs bsub
   3. leafwise + depthwise end-to-end s/tree for the variant selected by
-     LGBM_TPU_HIST_KERNEL (the hist-fn factories read the env at trace
-     time and are lru-cached, so run the script once per variant to get
-     both end-to-end numbers)
+     LGBM_TPU_HIST_KERNEL (read ONCE at import of ops.pallas_histogram
+     — jaxlint env-read-at-trace hoist — so EXPORT it before launching
+     and run the script once per variant to get both end-to-end
+     numbers; a mid-process os.environ flip is ignored)
 """
 
 import os
