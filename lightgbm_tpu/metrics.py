@@ -20,6 +20,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from .compat import enable_x64
+
 # jaxlint: disable-file=f64-literal-in-traced — the eval_jax reductions
 # deliberately accumulate in f64 under the enable_x64 context installed
 # by eval_jax_jit (f32 cumsums drift in the 4th AUC decimal at ~10M
@@ -53,8 +55,6 @@ class Metric:
         4th AUC decimal at ~10M rows; with >2^24 unit-weight rows the
         increments drop below f32 spacing entirely)."""
         import jax
-
-        from .compat import enable_x64
 
         with enable_x64(True):
             if self._jfn is None:
