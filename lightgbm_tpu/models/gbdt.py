@@ -570,6 +570,50 @@ class GBDT:
                 self._pending_stop.clear()
                 break
 
+    def snapshot_state(self) -> tuple:
+        """Capture every per-iteration mutable of the training state
+        for an EXACT rewind (restore_state).  Unlike rollback_one_iter
+        — whose (s + d) - d float32 round trip leaves ulp residue in
+        the scores — restore is bit-exact: the score buffers are device
+        COPIES (a bare reference would be donated into the next
+        _post_grow_step and deleted).  Used by bench.py to discard
+        warm-up trees so the timed model is byte-identical to a fresh
+        one.  Keep this field list in sync with train_one_iter's state
+        mutations."""
+        return (
+            jnp.array(self._scores),
+            len(self.models),
+            self.iter_,
+            self._bag_rng.get_state(),
+            self._feat_rng.get_state(),
+            self._bag_mask,  # immutable and never donated: ref is safe
+            self._bag_cnt,
+            [jnp.array(v) for v in getattr(self, "_valid_scores", [])],
+            # parked lagged-stop scalars (LGBM_TPU_STOP_LAG): device
+            # scalars, never donated — the shallow copy suffices
+            list(self._pending_stop),
+        )
+
+    def restore_state(self, snap: tuple) -> None:
+        """Rewind to a snapshot_state() capture (see its contract).
+        Restores COPIES of the score buffers so the snapshot stays
+        reusable — installing the captured array itself would let the
+        next _post_grow_step donate-and-delete it, making a second
+        restore crash on a deleted buffer."""
+        (scores, n_models, it, bag_state, feat_state, bag_mask,
+         bag_cnt, valid_scores, pending_stop) = snap
+        self._scores = jnp.array(scores)
+        del self.models[n_models:]
+        self.iter_ = it
+        self._bag_rng.set_state(bag_state)
+        self._feat_rng.set_state(feat_state)
+        self._bag_mask = bag_mask
+        self._bag_cnt = bag_cnt
+        for i, v in enumerate(valid_scores):
+            self._valid_scores[i] = jnp.array(v)
+        self._pending_stop[:] = pending_stop
+        self._model_version += 1
+
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:254-271): subtract the last
         iteration's trees from all scores and pop them."""
