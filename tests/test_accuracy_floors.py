@@ -30,6 +30,10 @@ def test_binary_breast_cancer_logloss():
     assert logloss < 0.15  # reference floor, test_engine.py:49
 
 
+@pytest.mark.slow  # 100 rounds x 10 classes = 1000 CPU trees, ~350s —
+# 44% of the whole tier-1 budget; multiclass CORRECTNESS stays tier-1
+# (test_gbdt/test_stacked_predict/test_sklearn_api), only this
+# accuracy floor runs in the slow tier
 def test_multiclass_digits_logloss():
     X, y = sklearn_datasets.load_digits(return_X_y=True)
     bst = _train(
@@ -50,9 +54,12 @@ def test_regression_diabetes_rmse():
     assert rmse < 55
 
 
-def test_lambdarank_reference_data_ndcg():
+def test_lambdarank_reference_data_ndcg(reference_examples):
     """NDCG@3 > 0.8 on the reference repo's bundled rank data
-    (test_sklearn.py:42-53)."""
+    (test_sklearn.py:42-53).  The fixture skips when the reference
+    checkout is absent (an environment condition, not a regression)."""
+    import os
+
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.metrics_rank import NDCGMetric
@@ -62,7 +69,7 @@ def test_lambdarank_reference_data_ndcg():
     cfg = Config(objective="lambdarank", metric=["ndcg"], num_leaves=31,
                  ndcg_eval_at=[1, 3, 5], is_save_binary_file=False)
     ds = BinnedDataset.from_file(
-        "/root/reference/examples/lambdarank/rank.train", cfg)
+        os.path.join(reference_examples, "lambdarank", "rank.train"), cfg)
     booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
     for _ in range(50):
         booster.train_one_iter()

@@ -12,7 +12,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_bench_always_emits_json_line():
     env = dict(os.environ)
-    env.update(BENCH_ROWS="20000", BENCH_TREES="2", BENCH_PLATFORM="cpu")
+    # BENCH_SKIP_REF: the contract under test is "one JSON line, always"
+    # — without it, a container that ships /root/reference would
+    # cmake-build the reference CLI inside this test and eat the whole
+    # tier-1 time budget
+    env.update(BENCH_ROWS="20000", BENCH_TREES="2", BENCH_PLATFORM="cpu",
+               BENCH_SKIP_REF="1")
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         capture_output=True, text=True, timeout=540, env=env, cwd=ROOT,

@@ -109,6 +109,7 @@ def test_dp_matches_serial_with_bagging_and_categoricals():
     _assert_trees_match(t_s, t_d)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_dp_gbdt_end_to_end():
     """Full boosting run with tree_learner=data reaches the same accuracy
     as serial on a learnable synthetic binary problem."""

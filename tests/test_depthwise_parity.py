@@ -47,12 +47,14 @@ def _train_auc(X, y, growth):
     return booster.eval_at(0)["auc"]
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_leafwise_auc_matches_reference(data):
     X, y = data
     auc = _train_auc(X, y, "leafwise")
     assert abs(auc - REF_AUC) <= 0.002, f"leafwise AUC {auc:.5f} vs {REF_AUC}"
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_depthwise_auc_tracks_reference(data):
     X, y = data
     auc = _train_auc(X, y, "depthwise")

@@ -360,3 +360,69 @@ def test_lagged_stop_drain_at_iteration_cap(monkeypatch):
     for t0, t4 in zip(b0.models, b4.models):
         np.testing.assert_array_equal(
             np.asarray(t0.split_feature), np.asarray(t4.split_feature))
+
+
+def test_snapshot_restore_rewinds_bit_exact():
+    """GBDT.snapshot_state/restore_state (the bench warm-up discard):
+    training after a restore must equal a fresh same-config run
+    byte-for-byte — including under bagging + feature sampling, whose
+    RNG streams the snapshot must rewind."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 6).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    cfg = dict(objective="binary", num_leaves=7, max_bin=32,
+               min_data_in_leaf=5, bagging_fraction=0.8, bagging_freq=2,
+               feature_fraction=0.7)
+
+    def fresh():
+        c = Config(**cfg)
+        ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=c)
+        return make_gbdt(c, ds)
+
+    a = fresh()
+    snap = a.snapshot_state()
+    for _ in range(3):  # "warm-up" trees to discard
+        a.train_one_iter()
+    a.restore_state(snap)
+    for _ in range(2):
+        a.train_one_iter()
+
+    b = fresh()
+    for _ in range(2):
+        b.train_one_iter()
+
+    assert a.save_model_to_string() == b.save_model_to_string()
+    np.testing.assert_array_equal(np.asarray(a._scores),
+                                  np.asarray(b._scores))
+
+    # a snapshot is REUSABLE: restore must install score copies, or the
+    # next train_one_iter's donation deletes the captured buffer and a
+    # second restore crashes on it
+    a.restore_state(snap)
+    a.train_one_iter()
+    a.restore_state(snap)
+    a.train_one_iter()
+    assert np.isfinite(np.asarray(a._scores)).all()
+
+
+def test_snapshot_restore_keeps_parked_stop_checks(monkeypatch):
+    """Under LGBM_TPU_STOP_LAG the parked num_leaves scalars are part of
+    the training state: restore must bring them back, not clear them
+    (a cleared queue would skip a pre-snapshot terminal stump and keep
+    growing where an uninterrupted run stops)."""
+    monkeypatch.setenv("LGBM_TPU_STOP_LAG", "4")
+    rng = np.random.RandomState(4)
+    X = rng.randn(300, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    cfg = Config(objective="binary", num_leaves=4, max_bin=16,
+                 min_data_in_leaf=5)
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    g = make_gbdt(cfg, ds)
+    g.train_one_iter()
+    g.train_one_iter()
+    parked = len(g._pending_stop)
+    assert parked > 0  # lag mode really parked entries
+    snap = g.snapshot_state()
+    g.train_one_iter()
+    g.restore_state(snap)
+    assert len(g._pending_stop) == parked

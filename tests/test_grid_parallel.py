@@ -60,6 +60,7 @@ def test_grid_matches_serial(shape, n, F):
     np.testing.assert_array_equal(np.asarray(leaf_ser), np.asarray(leaf_grid))
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_grid_through_gbdt_end_to_end():
     """tree_learner=grid through the full training API."""
     import lightgbm_tpu as lgb

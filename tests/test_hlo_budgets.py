@@ -1,0 +1,107 @@
+"""Tier-1 gate for jaxlint stage 2: the compiled-artifact budgets.
+
+This is the test that makes the round-5 regression class un-mergeable:
+a rework of serial.py/record.py that reintroduces a per-split
+full-record copy, drops buffer donation, or breaks the single-mention
+aliased record chain changes these small-shape compiled artifacts and
+fails here — BEFORE any bench run.
+
+The expensive measurement (trace+lower+compile of six entry points on
+CPU, ~15 s) runs once per module via the session fixture.
+"""
+
+import pytest
+
+from lightgbm_tpu.analysis import (
+    check_budgets,
+    load_budgets,
+    measure_entry_points,
+)
+
+
+@pytest.fixture(scope="module")
+def measured():
+    return measure_entry_points()
+
+
+@pytest.fixture(scope="module")
+def budgets():
+    return load_budgets()
+
+
+def test_all_entry_points_measurable(measured):
+    errors = {k: v["error"] for k, v in measured.items() if "error" in v}
+    assert not errors, errors
+
+
+def test_budgets_hold(measured, budgets):
+    """The committed budgets (analysis/budgets.json) hold for every
+    audited entry point: HLO op counts within ceiling, donation taken,
+    record chain single-mention.  See docs/jaxlint.md before touching
+    a budget."""
+    findings = check_budgets(measured, budgets, require_all=True)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_unmeasured_budget_entry_is_flagged(measured):
+    """require_all: a budget entry whose measurer vanished (rename/typo)
+    must fail the gate, not silently stop gating."""
+    budgets = {"entries": {"no_such_entry_point": {"copy": 1}}}
+    assert check_budgets(measured, budgets, require_all=True)
+    assert not check_budgets(measured, budgets)  # subset mode skips
+
+
+def test_split_kernel_copy_budget_pinned(measured, budgets):
+    """Regression pin for the split kernel's HLO copy count at the
+    one-TILE shape: the budgeted ceiling, and a sanity floor showing
+    the measurement is real (a 0-op parse would pass any ceiling)."""
+    ops = measured["split_step_window"]["ops"]
+    limit = budgets["entries"]["split_step_window"]["copy"]
+    assert 0 < ops.get("copy", 0) <= limit, (ops.get("copy"), limit)
+    # the program is non-trivial: the interpreted grid really lowered
+    assert sum(ops.values()) > 50, ops
+
+
+def test_gate_fails_when_copy_budget_exceeded(measured):
+    """The gate has teeth: against a budget one below the measured
+    copy count, check_budgets MUST report the violation (so a future
+    rework that adds copies fails test_budgets_hold the same way)."""
+    got = measured["split_step_window"]["ops"].get("copy", 0)
+    tight = {"entries": {"split_step_window": {"copy": got - 1}}}
+    findings = check_budgets(measured, tight)
+    assert len(findings) == 1 and findings[0].rule == "hlo-op-budget", (
+        findings)
+
+
+def test_gate_fails_when_donation_dropped(measured):
+    """Same, for donation: a measured entry with donation dropped must
+    produce an hlo-donation-dropped finding."""
+    broken = dict(measured)
+    broken["split_step_window"] = dict(
+        measured["split_step_window"],
+        donation=False,
+        donation_warnings=["Some donated buffers were not usable"],
+    )
+    findings = check_budgets(
+        broken, {"entries": {"split_step_window": {"donation": True}}})
+    assert [f.rule for f in findings] == ["hlo-donation-dropped"]
+
+
+def test_predictor_stays_gather_free(measured):
+    """ops/predict_matmul's whole point is zero indexed access; the
+    budget pins gather at 0 so an 'optimization' that reintroduces an
+    indexed walk fails loudly."""
+    assert measured["predict_matmul"]["ops"].get("gather", 0) == 0
+
+
+def test_donated_entry_points_alias(measured):
+    for name in ("split_step_window", "place_runs", "post_grow_step"):
+        m = measured[name]
+        assert m.get("has_alias"), name
+        assert not m.get("donation_warnings"), (name, m)
+
+
+def test_record_chain_single_use(measured):
+    for name in ("split_step_record_chain", "place_runs"):
+        assert measured[name].get("record_single_use") is True, (
+            name, measured[name])

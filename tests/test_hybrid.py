@@ -27,6 +27,7 @@ def _train_auc(X, y, growth, trees, leaves):
     return booster.eval_at(0)["auc"], booster
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_hybrid_matches_leafwise_auc():
     X, y = bench.make_data(60_000, seed=21)
     auc_leaf, _ = _train_auc(X, y, "leafwise", trees=20, leaves=63)

@@ -7,6 +7,7 @@ approximation by design; with 2*top_k >= num_features it degenerates to
 full data-parallel and must match up to reduction order.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -99,6 +100,7 @@ def test_voting_parallel_restricted_topk_still_learns():
     assert int(np.asarray(t_v.split_feature)[0]) == 17
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_feature_and_voting_parallel_matmul_hist():
     """FP and voting learners with per-shard MXU histograms match their
     segment_sum counterparts."""
@@ -151,6 +153,7 @@ def _total_gain(tree) -> float:
     return float(np.asarray(tree.split_gain)[: nl - 1].sum())
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP verify runs -m 'not slow'; see pyproject)
 def test_voting_parallel_restricted_top_k_quality():
     """PV-Tree at top_k < F (the configuration the algorithm exists
     for): the vote restricts which histograms are reduced, so trees may
