@@ -308,9 +308,61 @@ def _init_backend() -> str:
 _DATASET_CACHE: dict = {}
 
 
-def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
+def warm_until_compile_stable(step, max_warm: int | None = None,
+                              log_fn=log):
+    """Run ``step()`` (one warm iteration INCLUDING its sync) until the
+    two-signal gate says the loop is honest to time (ROADMAP item 1):
+    zero new backend compiles AND iteration-time stability (lazy Mosaic
+    kernels compile inside an already-compiled executable and emit no
+    JAX event — they show up as a slow iteration instead).  At least
+    two iterations: the stability test needs a baseline before a slow
+    (lazily-compiling) iteration can be told apart from steady state.
+
+    Returns ``(warmed_iters, compile_stable)``.  Shared by the bench
+    warm-up and tools/telemetry_overhead.py so the committed overhead
+    proof warms under exactly the discipline of the headline it
+    certifies."""
+    from lightgbm_tpu.analysis.recompile import compile_counter
+
+    if max_warm is None:
+        max_warm = int(os.environ.get("BENCH_MAX_WARM", "12"))
+    cc = compile_counter()
+    t_min = None
+    warmed = 0
+    for warmed in range(1, max_warm + 1):
+        t1 = time.perf_counter()
+        step()
+        dt = time.perf_counter() - t1
+        new_compiles = cc.delta()
+        cc.reset()
+        t_min = dt if t_min is None else min(t_min, dt)
+        if warmed >= 2 and new_compiles == 0 and dt <= 1.5 * t_min:
+            log_fn(f"warm-up compile-stable after {warmed} extra "
+                   f"iteration(s) (last {dt:.3f}s)")
+            return warmed, True
+        log_fn(f"warm-up iter {warmed}: {dt:.3f}s, "
+               f"{new_compiles} new compile(s)")
+    if max_warm > 0:
+        log_fn(f"warm-up NOT compile-stable after {max_warm} iterations; "
+               "timing anyway (BENCH_MAX_WARM to raise)")
+    return warmed, False
+
+
+def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None,
+                      reservoir: str = "tree_s"):
     """Train TREES trees; caller has already resolved the backend via
-    _init_backend() (so failures here happen ON the resolved platform)."""
+    _init_backend() (so failures here happen ON the resolved platform).
+
+    Returns ``(sec_per_tree, train_auc, valid_auc, info)`` where
+    ``info`` carries the run's self-description (warm-up iteration
+    count, discarded warm trees, compile counters for the warm-up and
+    the timed loop, optional phase breakdown) — the evidence the
+    RunManifest and the BENCH json record so a regression like round
+    5's (12 lazy compiles inside the timed segment, unrecorded) can
+    never again hide behind a bare s/tree number.  ``reservoir`` names
+    the telemetry reservoir the timed per-tree times land in; a
+    secondary (depthwise) run must NOT share the headline's "tree_s"
+    or the manifest's p50/p99 would blend both growth modes."""
 
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
@@ -330,11 +382,14 @@ def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
         metric=["auc"],
         tree_growth=growth,
     )
+    from lightgbm_tpu.obs import telemetry
+
     if "ds" in _DATASET_CACHE:
         ds = _DATASET_CACHE["ds"]
     else:
         t0 = time.perf_counter()
-        ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+        with telemetry.span("bench.binning"):
+            ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
         log(f"binning: {time.perf_counter() - t0:.1f}s")
         _DATASET_CACHE["ds"] = ds
     obj = create_objective(cfg, ds.metadata, ds.num_data)
@@ -355,6 +410,9 @@ def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
     # warmup: first iteration compiles.  If the Pallas histogram path
     # fails on this backend, fall back to the segment_sum path rather
     # than failing the whole bench.
+    from lightgbm_tpu.analysis.recompile import compile_counter
+
+    cc_phase = compile_counter()  # compiles per bench phase (manifest)
     t0 = time.perf_counter()
     try:
         booster.train_one_iter()
@@ -381,38 +439,14 @@ def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
     # iteration is NOT enough: the tier-capacity Mosaic kernels compile
     # lazily the first time a SPLIT lands in their branch, which can be
     # trees into the run — round 5's timed loops carried ~12 lazy
-    # per-tier compiles in their first segment.  Two independent
-    # signals, both required quiet before timing starts:
-    #   * the analysis subsystem's backend-compile counter (exact for
-    #     XLA retraces/recompiles; cache hits count zero), and
-    #   * iteration-time stability (lazy Mosaic compiles happen inside
-    #     an already-compiled executable and emit no JAX event — they
-    #     show up as a slow iteration instead).
-    from lightgbm_tpu.analysis.recompile import compile_counter
-
-    cc = compile_counter()
-    max_warm = int(os.environ.get("BENCH_MAX_WARM", "12"))
-    t_min = None
-    for warmed in range(1, max_warm + 1):
-        t1 = time.perf_counter()
+    # per-tier compiles in their first segment.  Gate shared with the
+    # overhead proof: warm_until_compile_stable above.
+    def _warm_step():
         booster.train_one_iter()
         _ = np.asarray(booster._scores[0, :1])
-        dt = time.perf_counter() - t1
-        new_compiles = cc.delta()
-        cc.reset()
-        t_min = dt if t_min is None else min(t_min, dt)
-        # at least two warm iterations: the stability test needs a
-        # baseline before a slow (lazily-compiling) first iteration
-        # can be told apart from steady state
-        if warmed >= 2 and new_compiles == 0 and dt <= 1.5 * t_min:
-            log(f"warm-up compile-stable after {warmed} extra "
-                f"iteration(s) (last {dt:.3f}s)")
-            break
-        log(f"warm-up iter {warmed}: {dt:.3f}s, "
-            f"{new_compiles} new compile(s)")
-    else:
-        log(f"warm-up NOT compile-stable after {max_warm} iterations; "
-            "timing anyway (BENCH_MAX_WARM to raise)")
+
+    with telemetry.span("bench.warmup"):
+        warmed, compile_stable = warm_until_compile_stable(_warm_step)
 
     # restore the pre-warm-up snapshot (the compile tree included) so
     # the timed model ends at EXACTLY the trees the reference CLI
@@ -426,23 +460,51 @@ def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
     booster.restore_state(snap)
     log(f"discarded {warm_trees} warm-up tree(s); timed model will "
         f"hold exactly the trees it grows")
+    compiles_warmup = cc_phase.delta()
+    cc_phase.reset()
+
+    # optional device-time attribution: LGBM_TPU_TRACE=<dir> captures a
+    # profiler trace of the timed loop and buckets it into the grow-loop
+    # phases (obs.device_time).  Off by default — the profiler is NOT
+    # near-zero-overhead, so it must never silently tax the headline.
+    import contextlib
+
+    from lightgbm_tpu.obs.device_time import trace_phases
+
+    trace_dir = os.environ.get("LGBM_TPU_TRACE", "")
+    tracer = trace_phases(trace_dir) if trace_dir else None
 
     done = 0
-    t0 = time.perf_counter()
-    for i in range(TREES):
-        booster.train_one_iter()
-        # sync only every 5 trees (for the budget check): a per-tree
-        # block_until_ready exposes the full axon-tunnel RTT + pipeline
-        # stall each iteration (~0.3 s/tree measured at 1M rows —
-        # tools/profile_split.py steady state vs the round-3 bench rows)
-        done += 1
-        if i % 5 == 4:
-            _ = np.asarray(booster._scores[0, :1])
-            if time.perf_counter() - t0 > BUDGET_S:
-                log(f"budget hit after {done} trees")
-                break
-    _ = np.asarray(booster._scores)
-    elapsed = time.perf_counter() - t0
+    # the with-block guarantees stop_trace on ANY exit: a booster crash
+    # mid-loop must not leave the profiler taxing the rest of the
+    # process (and poisoning the next trace_phases with a double-start)
+    with (tracer if tracer is not None else contextlib.nullcontext()):
+        t0 = time.perf_counter()
+        with telemetry.span("bench.timed_loop"):
+            for i in range(TREES):
+                t_iter = time.perf_counter()
+                booster.train_one_iter()
+                # sync only every 5 trees (for the budget check): a
+                # per-tree block_until_ready exposes the full
+                # axon-tunnel RTT + pipeline stall each iteration
+                # (~0.3 s/tree measured at 1M rows —
+                # tools/profile_split.py steady state vs the round-3
+                # bench rows)
+                done += 1
+                if i % 5 == 4:
+                    telemetry.host_sync()
+                    _ = np.asarray(booster._scores[0, :1])
+                # per-tree reservoir (manifest p50/p99): dispatch wall
+                # for 4 of 5 trees, the 5th absorbs the sync — the p50
+                # tracks dispatch cost, the p99 the sync'd envelope
+                telemetry.record_value(reservoir,
+                                       time.perf_counter() - t_iter)
+                if i % 5 == 4 and time.perf_counter() - t0 > BUDGET_S:
+                    log(f"budget hit after {done} trees")
+                    break
+        _ = np.asarray(booster._scores)
+        elapsed = time.perf_counter() - t0
+    compiles_timed = cc_phase.delta()
     booster.finish_lagged_stop()
     auc = booster.eval_at(0).get("auc", float("nan"))
     valid_auc = float("nan")
@@ -456,7 +518,48 @@ def ours_sec_per_tree(X, y, growth: str, Xv=None, yv=None):
         valid_auc = booster.eval_at(1).get("auc", float("nan"))
     log(f"ours: {done} trees in {elapsed:.1f}s, train AUC={auc:.4f}, "
         f"valid AUC={valid_auc:.4f}")
-    return elapsed / done, auc, valid_auc
+    info = {
+        "warmup_iters": warmed,
+        "warm_trees_discarded": warm_trees,
+        "compile_stable": compile_stable,
+        "compiles_warmup": compiles_warmup,
+        "compiles_timed": compiles_timed,
+        "timed_trees": done,
+    }
+    if tracer is not None and tracer.phases:
+        info["phases"] = tracer.phases
+    return elapsed / done, auc, valid_auc, info
+
+
+def _emit_result(out: dict, info: dict, key: str) -> None:
+    """Write the RunManifest next to the bench artifacts, then print the
+    single JSON result line (ALWAYS the last thing on stdout, manifest
+    failure included — the driver contract is one JSON line, whatever
+    happens)."""
+    try:
+        from lightgbm_tpu.obs import RunManifest, telemetry
+
+        mdir = os.environ.get("BENCH_MANIFEST_DIR", CACHE_DIR)
+        path = os.path.join(mdir, f"bench_{key}.manifest.json")
+        manifest = RunManifest.collect(
+            "bench.py",
+            config={"rows": ROWS, "trees": TREES, "valid_rows": VROWS,
+                    "num_leaves": NUM_LEAVES, "num_bins": NUM_BINS,
+                    "learning_rate": LEARNING_RATE, "min_data": MIN_DATA,
+                    "growth": out.get("growth")},
+            result=out,
+            phases=info.get("phases"),
+            warmup={k: info[k] for k in (
+                "warmup_iters", "warm_trees_discarded", "compile_stable",
+                "compiles_warmup", "compiles_timed") if k in info},
+        )
+        manifest.write(path)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        out["manifest"] = os.path.relpath(path, repo)
+        telemetry.emit_if_json()
+    except Exception as e:
+        log(f"manifest write failed: {type(e).__name__}: {e}")
+    print(json.dumps(out), flush=True)
 
 
 def main() -> None:
@@ -470,6 +573,7 @@ def main() -> None:
         "vs_baseline": 0.0,
         "platform": "none",
     }
+    info: dict = {}
     try:
         # platform is stamped into the row the moment the backend
         # resolves: an on-TPU failure must emit platform "tpu" (a
@@ -488,9 +592,15 @@ def main() -> None:
         else:  # BENCH_VALID=0 disables the out-of-sample column
             (X, y), Xv, yv = make_data(ROWS), None, None
         growth = os.environ.get("BENCH_GROWTH", "leafwise")
-        ours, auc, valid_auc = ours_sec_per_tree(X, y, growth, Xv, yv)
+        ours, auc, valid_auc, info = ours_sec_per_tree(X, y, growth, Xv, yv)
         out["value"] = round(ours, 4)
         out["growth"] = growth
+        # self-description (VERDICT r5 item 4): the warm-up and compile
+        # evidence ships INSIDE the BENCH row, so a number measured over
+        # lazy compiles can be seen to be one
+        out.update({k: info[k] for k in (
+            "warmup_iters", "warm_trees_discarded", "compile_stable",
+            "compiles_warmup", "compiles_timed", "timed_trees")})
         knobs = {k: os.environ[k] for k in _TUNED_KEYS if k in os.environ}
         if knobs:
             out["knobs"] = knobs
@@ -501,7 +611,7 @@ def main() -> None:
             # contract/CI mode: our own number without the reference
             # baseline — building the reference CLI (cmake+make) inside
             # a test would eat the whole tier-1 time budget
-            print(json.dumps(out), flush=True)
+            _emit_result(out, info, key)
             return
         ref, ref_auc, ref_valid_auc = reference_sec_per_tree(X, y, key, Xv, yv)
         if ref and ours > 0:
@@ -524,7 +634,8 @@ def main() -> None:
             out["valid_auc_gap"] = round(vgap, 4)
         if os.environ.get("BENCH_SECONDARY", "0") != "0":
             # optional secondary row: the level-synchronous approximation
-            sec, sec_auc, _ = ours_sec_per_tree(X, y, "depthwise")
+            sec, sec_auc, _, _ = ours_sec_per_tree(
+                X, y, "depthwise", reservoir="tree_s_secondary")
             out["secondary"] = {
                 "growth": "depthwise", "value": round(sec, 4),
                 "train_auc": round(float(sec_auc), 4),
@@ -535,7 +646,7 @@ def main() -> None:
         import traceback
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {str(e)[:200]}"
-    print(json.dumps(out), flush=True)
+    _emit_result(out, info, key)
 
 
 if __name__ == "__main__":

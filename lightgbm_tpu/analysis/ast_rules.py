@@ -52,6 +52,16 @@ class Finding:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
 
 
+class _Loc:
+    """Synthetic location carrier for findings computed after the walk
+    (only ``lineno`` is read by :meth:`_RuleWalker.flag`)."""
+
+    __slots__ = ("lineno",)
+
+    def __init__(self, lineno: int) -> None:
+        self.lineno = lineno
+
+
 # rule id -> one-line description (the CLI prints this table)
 AST_RULES: Dict[str, str] = {
     "host-sync-in-jit": (
@@ -89,6 +99,14 @@ AST_RULES: Dict[str, str] = {
         "module: one device sync per iteration drains the dispatch "
         "pipeline (measured ~0.3 s/tree over the TPU tunnel at 1M rows)"
     ),
+    "wallclock-without-sync": (
+        "time.time()/perf_counter() stop timestamp around jax/jnp "
+        "device computation with no block_until_ready/device_get/"
+        "np.asarray sync before the stop: async dispatch returns "
+        "before the device finishes, so the elapsed time measures "
+        "dispatch, not compute (the mis-timing hazard behind every "
+        "too-good-to-be-true bench number)"
+    ),
 }
 
 _HOT_DIR_PARTS = ("learners", "ops", "parallel")
@@ -108,6 +126,13 @@ _SAFE_ITER_CALLS = {
 
 _PRAGMA_LINE = re.compile(r"#\s*jaxlint:\s*disable=([\w,\-]+)")
 _PRAGMA_FILE = re.compile(r"#\s*jaxlint:\s*disable-file=([\w,\-]+)")
+
+# wallclock-without-sync machinery: wall-clock sources, device-compute
+# roots, and the sync calls that make a stop timestamp honest
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "perf_counter", "monotonic"}
+_DEVICE_ROOTS = {"jax", "jnp"}
+_SYNC_LEAVES = {"block_until_ready", "device_get", "item", "tolist"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -206,12 +231,20 @@ class _RuleWalker(ast.NodeVisitor):
     """Walk one function body with (traced, hot, loop-depth) context."""
 
     def __init__(self, path: str, traced: bool, hot: bool,
-                 findings: List[Finding]) -> None:
+                 findings: List[Finding],
+                 jit_roots: Optional[Set[str]] = None) -> None:
         self.path = path
         self.traced = traced
         self.hot = hot
         self.findings = findings
         self.loop_depth = 0
+        self.jit_roots = jit_roots or set()
+        # wallclock-without-sync event streams (line-ordered within the
+        # walked function; nested defs are walked separately)
+        self._time_marks: Dict[str, List[int]] = {}
+        self._device_lines: List[int] = []
+        self._sync_lines: List[int] = []
+        self._stops: List[Tuple[int, str]] = []
 
     def flag(self, rule: str, node: ast.AST, msg: str) -> None:
         self.findings.append(
@@ -269,6 +302,85 @@ class _RuleWalker(ast.NodeVisitor):
         self._check_environ(node, node.value)
         self.generic_visit(node)
 
+    # -------------------------------------------- wallclock-without-sync
+    @staticmethod
+    def _is_time_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and _dotted(node.func) in _TIME_CALLS)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._is_time_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._time_marks.setdefault(tgt.id, []).append(
+                        node.lineno)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        # stop timestamp: `time.perf_counter() - t0` (t0 a recorded mark)
+        if (isinstance(node.op, ast.Sub) and self._is_time_call(node.left)
+                and isinstance(node.right, ast.Name)):
+            self._stops.append((node.lineno, node.right.id))
+        self.generic_visit(node)
+
+    def _note_wallclock_call(self, node: ast.Call, name: Optional[str],
+                             leaf: Optional[str]) -> None:
+        """Record device-compute and sync events for the linear
+        wallclock scan.  Device compute = a jax/jnp-rooted call (minus
+        the sync API) or a call into one of this module's jit roots;
+        sync = anything that blocks on device results."""
+        line = getattr(node, "lineno", 0)
+        if name is not None:
+            root = name.split(".")[0]
+            if leaf in _SYNC_LEAVES or (root in _NP_NAMES
+                                        and leaf in _NP_SYNC_FUNCS):
+                self._sync_lines.append(line)
+                return
+            if leaf in ("float", "int") and name == leaf:
+                # float(x)/int(x) of a device scalar is a sync; of host
+                # data it is harmless — treating it as a sync errs on
+                # the quiet side for THIS rule (host-sync-in-loop owns
+                # the opposite direction)
+                self._sync_lines.append(line)
+                return
+            if name.startswith(("jax.profiler.", "jax.config.",
+                                "jax.monitoring.")):
+                return  # harness/profiler API, not device compute
+            if root in _DEVICE_ROOTS or leaf in self.jit_roots:
+                self._device_lines.append(line)
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_LEAVES:
+            self._sync_lines.append(line)
+
+    def finish(self) -> None:
+        """Evaluate collected wallclock stop timestamps (called once
+        after the whole function body is visited).  Traced code is
+        exempt: a wall-clock read there is trace-time Python with its
+        own failure mode (it would be constant-folded), not an async
+        mis-timing."""
+        if self.traced:
+            return
+        for stop_line, mark in self._stops:
+            starts = [ln for ln in self._time_marks.get(mark, ())
+                      if ln < stop_line]
+            if not starts:
+                continue
+            start_line = max(starts)
+            devs = [ln for ln in self._device_lines
+                    if start_line < ln <= stop_line]
+            syncs = [ln for ln in self._sync_lines
+                     if start_line < ln <= stop_line]
+            if devs and not syncs:
+                self.flag(
+                    "wallclock-without-sync",
+                    _Loc(stop_line),
+                    f"elapsed-time stop at line {stop_line} times device "
+                    f"work dispatched at line(s) {devs} with no "
+                    "block_until_ready()/device_get/np.asarray before "
+                    "the stop: async dispatch makes this measure launch "
+                    "cost, not compute",
+                )
+
     def visit_Attribute(self, node: ast.Attribute) -> None:
         if self.traced and _dotted(node) in ("jnp.float64", "np.float64",
                                              "numpy.float64",
@@ -290,6 +402,8 @@ class _RuleWalker(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
         leaf = name.split(".")[-1] if name else None
+
+        self._note_wallclock_call(node, name, leaf)
 
         # env-read-at-trace: os.environ.get(...) / os.getenv(...)
         if self.traced and name in ("os.environ.get", "os.getenv",
@@ -409,9 +523,11 @@ def lint_source(source: str, path: str = "<string>",
     findings: List[Finding] = []
 
     def walk_fn(fn: ast.AST, is_traced: bool) -> None:
-        walker = _RuleWalker(path, is_traced, hot, findings)
+        walker = _RuleWalker(path, is_traced, hot, findings,
+                             jit_roots=index.jit_roots)
         for stmt in fn.body:  # type: ignore[attr-defined]
             walker.visit(stmt)
+        walker.finish()
 
     seen: Set[int] = set()
 

@@ -12,6 +12,7 @@ Reference ``examples/*/train.conf`` files parse and run unchanged.
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -25,6 +26,7 @@ from .io.dataset import BinnedDataset
 from .log import Log
 from .models.dart import create_boosting
 from .models.gbdt import GBDT
+from .obs import RunManifest, manifest_path, telemetry
 from .objectives import create_objective
 
 
@@ -140,6 +142,12 @@ def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],
 
 def run_train(cfg: Config) -> GBDT:
     """InitTrain + Train (application.cpp:187-239)."""
+    # install the backend-compile listener BEFORE the first jax trace so
+    # the run manifest's compile count covers the whole run (the
+    # listener only sees events fired after registration)
+    from .analysis.recompile import compile_counter
+
+    compile_counter()
     if cfg.is_parallel and cfg.num_machines > 1:
         # Network::Init analog (application.cpp:190): attach this process
         # to the multi-host JAX runtime before any data loads, so the
@@ -213,7 +221,44 @@ def run_train(cfg: Config) -> GBDT:
     )
     booster.save_model_to_file(cfg.output_model, num_iteration)
     Log.info(f"Finished training, saved model to {cfg.output_model}")
+    _write_train_manifest(cfg, booster, time.perf_counter() - start,
+                          profiler_ctx)
     return booster
+
+
+def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
+                          profile_dir: Optional[str]) -> None:
+    """RunManifest next to the saved model (``<output_model>.manifest
+    .json``): every CLI training run leaves the same self-describing
+    evidence as a bench run.  When ``profile=true`` captured a trace,
+    the grow-loop phase breakdown is bucketed out of it; otherwise
+    phases stay empty (host timers cannot see inside the jitted loop).
+    Best-effort: a manifest failure must not fail a finished training
+    run."""
+    try:
+        phases = {}
+        if profile_dir:
+            from .obs.device_time import phase_breakdown_from_trace
+
+            phases = phase_breakdown_from_trace(profile_dir)
+        manifest = RunManifest.collect(
+            "cli.train", config=cfg,
+            result={"num_trees": booster.num_trees,
+                    "train_wall_s": round(train_s, 3),
+                    "output_model": cfg.output_model},
+            phases=phases,
+            per_tree_reservoir="tree_dispatch_s",
+        )
+        path = manifest.write(manifest_path(cfg.output_model))
+        Log.info(f"Wrote run manifest to {path}")
+        if cfg.verbose >= 2:
+            # structured telemetry tail (docs/observability.md): one
+            # debug line a tool can parse out of the CLI log
+            Log.debug("telemetry " + json.dumps(
+                telemetry.get_telemetry().snapshot(), sort_keys=True))
+        telemetry.emit_if_json()
+    except Exception as e:
+        Log.warning(f"run manifest write failed: {type(e).__name__}: {e}")
 
 
 def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],

@@ -63,6 +63,7 @@ _TIER_SPACING_ENV = max(
     2, int(_os.environ.get("LGBM_TPU_TIER_SPACING", "2")))
 
 from ..models.tree import Tree
+from ..obs import telemetry
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from ..ops.split import (
     SplitResult, find_best_split, find_best_split_leaves, K_MIN_SCORE)
@@ -343,6 +344,11 @@ def grow_tree(
     re-cast for static shapes.  ``0`` (default) keeps every leaf
     resident.
     """
+    # Python here runs once per TRACE, so this counts grow-program
+    # retraces exactly (obs: a timed loop whose grow_traces counter
+    # moves is re-tracing — the same hazard the bench warm-up gate and
+    # the steady-loop recompile test watch from the compile side)
+    telemetry.count("grow_traces")
     F, n = bins_T.shape
     L = max_leaves
     h_tiers = _hist_tiers(n)

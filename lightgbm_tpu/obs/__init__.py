@@ -1,0 +1,58 @@
+"""Runtime observability: telemetry, phase-attributed device time,
+self-describing run manifests.
+
+The runtime half of ROADMAP item 1's "make perf un-regressable"
+(jaxlint in ``analysis/`` is the static half):
+
+* :mod:`~lightgbm_tpu.obs.telemetry` — always-on spans / counters /
+  per-tree reservoirs (near-zero overhead; no jax import).
+* :mod:`~lightgbm_tpu.obs.device_time` — ``phase_scope`` annotations on
+  the hot ops + profiler-trace bucketing into histogram / split-search
+  / partition / leaf-update (imports jax; loaded lazily so tools that
+  only read manifests don't pay for it).
+* :mod:`~lightgbm_tpu.obs.manifest` — ``RunManifest`` written next to
+  every bench result artifact; diffed by ``tools/benchdiff.py``.
+
+See docs/observability.md for the schemas and the reading guide.
+"""
+
+from __future__ import annotations
+
+from . import telemetry  # noqa: F401
+from .manifest import (  # noqa: F401
+    RunManifest,
+    config_fingerprint,
+    manifest_path,
+    validate,
+)
+from .telemetry import (  # noqa: F401
+    Reservoir,
+    SpanStat,
+    Telemetry,
+    collective_stats,
+    count,
+    emit_if_json,
+    enabled,
+    get_telemetry,
+    host_sync,
+    record_collectives,
+    record_value,
+    set_enabled,
+    span,
+)
+
+_LAZY = ("phase_scope", "host_annotation", "bucket_events",
+         "classify_event", "phase_breakdown_from_trace",
+         "load_trace_events", "trace_phases", "PHASES", "SCOPE_TO_PHASE")
+
+
+def __getattr__(name):
+    # device_time imports jax; bridge it lazily so manifest/telemetry
+    # consumers (benchdiff, lint tooling) stay jax-free
+    if name in _LAZY or name == "device_time":
+        from . import device_time
+
+        if name == "device_time":
+            return device_time
+        return getattr(device_time, name)
+    raise AttributeError(name)

@@ -1,0 +1,239 @@
+"""Phase-attributed device time for the grow loop.
+
+Host timers cannot see inside the jitted ``fori_loop`` — by the time
+``train_one_iter`` returns, the chip may not even have started, and
+every split of every leaf runs inside one compiled program.  Attribution
+therefore comes from two cooperating halves:
+
+1. **Scope annotations at trace time** (:func:`phase_scope`): the hot
+   ops (``ops/record.py``, ``ops/pallas_histogram.py``,
+   ``ops/histogram.py``, ``ops/split.py``, ``ops/predict_matmul.py``,
+   the post-grow update in ``models/gbdt.py``) wrap their lowered
+   computations in ``jax.named_scope`` so every XLA op's metadata
+   carries an ``lgbm.<phase>`` path that survives fusion into the
+   profiler trace's event names/args.  ``jax.named_scope`` costs a name
+   stack push at *trace* time and literally nothing at run time, so the
+   always-on telemetry constraint holds.
+2. **Trace bucketing at read time** (:func:`bucket_events`,
+   :func:`phase_breakdown_from_trace`): parse a ``jax.profiler`` trace
+   (chrome-trace JSON, the format ``jax.profiler.trace`` writes under
+   ``<dir>/plugins/profile/<run>/*.trace.json.gz``) and bucket complete
+   events into the four grow-loop phases — histogram / split-search /
+   partition / leaf-update — plus predict, falling back to kernel-name
+   patterns for ops that lost their scope path in fusion naming
+   (promotes the ad-hoc breakdown logic of ``tools/tpu_breakdown.py``
+   into the library).
+
+Capture is opt-in (``with trace_phases(dir) as result: ...`` or the
+``LGBM_TPU_TRACE=<dir>`` env consumed by bench.py): running the
+profiler is NOT near-zero-overhead, so the always-on layer records only
+scopes and counters, and a trace is taken when someone asks where the
+device time went.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional
+
+import jax
+
+# The four grow-loop phases (plus predict for the inference path and
+# the unattributed remainder).  Keys are the manifest schema.
+PHASES = ("histogram", "split-search", "partition", "leaf-update",
+          "predict")
+
+# named_scope path -> phase.  The split-step mega kernel fuses child
+# histogram accumulation INTO the partition pass (ops/record.py); its
+# device time is bucketed as partition because the routing dots, not
+# the binning math, dominate it (BASELINE.md round-5 profile).
+SCOPE_TO_PHASE: Dict[str, str] = {
+    "lgbm.histogram": "histogram",
+    "lgbm.split_search": "split-search",
+    "lgbm.partition": "partition",
+    "lgbm.split_step": "partition",
+    "lgbm.leaf_update": "leaf-update",
+    "lgbm.predict": "predict",
+}
+
+# kernel-name fallbacks, first match wins — for events whose fusion
+# name kept the op stem but lost the scope path
+_KERNEL_PATTERNS = (
+    (re.compile(r"hist", re.I), "histogram"),
+    (re.compile(r"split_step|place|compact|partition|route|write_window",
+                re.I), "partition"),
+    (re.compile(r"best_split|search|gain", re.I), "split-search"),
+    (re.compile(r"post_grow|leaf_value|shrink", re.I), "leaf-update"),
+    (re.compile(r"predict|ensemble|path_table|tree_hit", re.I), "predict"),
+)
+
+
+def phase_scope(phase: str):
+    """Trace-time scope for a grow-loop phase: ops wrap their traced
+    bodies in ``with phase_scope("histogram"): ...`` (or use it as a
+    decorator under the ``jax.jit`` one) so XLA op metadata — and thus
+    profiler event names — carries ``lgbm.<phase>``.  Zero run-time
+    cost: it only pushes the tracing name stack.  Dashes normalize to
+    underscores so scope names match :data:`SCOPE_TO_PHASE` keys."""
+    return jax.named_scope("lgbm." + phase.replace("-", "_"))
+
+
+def host_annotation(name: str):
+    """Host-side profiler annotation (``jax.profiler.TraceAnnotation``)
+    for eager regions — shows up as a TraceMe on the host track.  Used
+    around host phases (binning, eval) when a trace is being captured;
+    unlike :func:`phase_scope` it has a (tiny) run-time cost, so call
+    sites keep it out of per-split paths."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def classify_event(name: str, long_name: str = "") -> Optional[str]:
+    """Phase for one trace event, or None when unattributable."""
+    hay = f"{name} {long_name}"
+    for scope, phase in SCOPE_TO_PHASE.items():
+        if scope in hay:
+            return phase
+    for pat, phase in _KERNEL_PATTERNS:
+        if pat.search(hay):
+            return phase
+    return None
+
+
+def _event_long_name(ev: dict) -> str:
+    args = ev.get("args")
+    if not isinstance(args, dict):
+        return ""
+    return " ".join(
+        str(args.get(k, "")) for k in ("long_name", "tf_op", "hlo_op",
+                                       "name", "hlo_module"))
+
+
+def _is_xla_event(ev: dict) -> bool:
+    """Does this event describe XLA/device work (vs a host Python
+    TraceMe)?  XLA-emitted events carry op args; host TraceMes
+    ('$builtins isinstance', 'TfrtCpuExecutable::Execute', ...) don't."""
+    args = ev.get("args")
+    if isinstance(args, dict) and any(
+            k in args for k in ("hlo_op", "hlo_module", "tf_op",
+                                "long_name")):
+        return True
+    return False
+
+
+def bucket_events(events: Iterable[dict]) -> Dict[str, float]:
+    """Bucket chrome-trace complete events into phase -> seconds.
+
+    Only ``ph == "X"`` events with a duration participate.  Device
+    tracks are detected from the ``process_name`` metadata (TPU/XLA/GPU
+    device pids); when track metadata is absent (synthetic tests, CPU
+    traces) every timed event is considered.  Unmatched XLA time is
+    reported under ``"unattributed"`` so a breakdown can never silently
+    claim full coverage; events that match no phase AND carry no XLA op
+    args (host-side Python TraceMes) are dropped entirely.
+
+    Backend caveat: op-level attribution needs a profiler that exports
+    the HLO ``op_name`` metadata path into event args (the TPU plugin
+    does).  The CPU tracer emits bare thunk names, so CPU traces bucket
+    almost everything to ``unattributed`` — the scopes are still in the
+    compiled HLO (pinned by tests), the CPU profiler just doesn't
+    surface them.
+    """
+    events = list(events)
+    device_pids = set()
+    have_meta = False
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            have_meta = True
+            pname = str((ev.get("args") or {}).get("name", ""))
+            if re.search(r"TPU|XLA|/device|GPU", pname, re.I):
+                device_pids.add(ev.get("pid"))
+    out: Dict[str, float] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        if have_meta and device_pids and ev.get("pid") not in device_pids:
+            continue
+        sec = float(ev["dur"]) / 1e6  # chrome trace durations are us
+        phase = classify_event(str(ev.get("name", "")),
+                               _event_long_name(ev))
+        if phase is None and not _is_xla_event(ev):
+            continue
+        key = phase if phase is not None else "unattributed"
+        out[key] = out.get(key, 0.0) + sec
+    return {k: round(v, 6) for k, v in out.items()}
+
+
+def load_trace_events(trace_dir: str) -> List[dict]:
+    """Trace events of the NEWEST capture under a ``jax.profiler.trace``
+    output dir.  The profiler writes a fresh timestamped
+    ``plugins/profile/<run>/`` per capture and never cleans old ones,
+    so a reused trace dir holds several runs — summing across them
+    would double phase seconds (and benchdiff would then flag phantom
+    per-phase regressions).  Only files from the latest run directory
+    (timestamped names sort lexicographically) are read."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                    recursive=True)
+    )
+    if paths:
+        newest_run = max(os.path.dirname(p) for p in paths)
+        paths = [p for p in paths if os.path.dirname(p) == newest_run]
+    events: List[dict] = []
+    for p in paths:
+        opener = gzip.open if p.endswith(".gz") else open
+        try:
+            with opener(p, "rt", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except Exception:
+            continue
+        evs = data.get("traceEvents") if isinstance(data, dict) else data
+        if isinstance(evs, list):
+            events.extend(e for e in evs if isinstance(e, dict))
+    return events
+
+
+def phase_breakdown_from_trace(trace_dir: str) -> Dict[str, float]:
+    """Phase -> device seconds for a captured trace directory."""
+    return bucket_events(load_trace_events(trace_dir))
+
+
+class trace_phases:
+    """Capture a profiler trace around a block and bucket it:
+
+        with trace_phases("/tmp/lgbm_trace") as result:
+            run_timed_loop()
+        print(result.phases)   # {"histogram": ..., "partition": ...}
+
+    Failure to start/stop the profiler (no TensorFlow profiler plugin,
+    double-start) degrades to an empty breakdown rather than killing
+    the run — a bench harness whose failure mode is "no number" is
+    itself a defect (bench.py module docstring).
+    """
+
+    def __init__(self, trace_dir: str) -> None:
+        self.trace_dir = trace_dir
+        self.phases: Dict[str, float] = {}
+        self._started = False
+
+    def __enter__(self) -> "trace_phases":
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+            self._started = True
+        except Exception:
+            self._started = False
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self._started:
+            return
+        try:
+            jax.profiler.stop_trace()
+            self.phases = phase_breakdown_from_trace(self.trace_dir)
+        except Exception:
+            self.phases = {}

@@ -1,0 +1,387 @@
+"""Always-on runtime telemetry: spans, counters, per-tree reservoirs.
+
+The round-5 regression (BENCH_r05 vs_baseline 0.71) shipped unnoticed
+because no training run records where its time goes.  This module is
+the runtime half of the fix (jaxlint is the static half): every process
+carries a near-zero-overhead telemetry singleton that any entry point
+can snapshot into a :class:`~lightgbm_tpu.obs.manifest.RunManifest`.
+
+Design constraints, in order:
+
+* **Near-zero overhead on the hot path.**  A span is two
+  ``time.perf_counter()`` calls and two dict operations; a counter is
+  one dict add.  Nothing here touches a device array, forces a sync, or
+  allocates per-iteration beyond a float append.  The bound is itself
+  an acceptance criterion (``tools/telemetry_overhead.py``, ≤2% at the
+  100k driver-like shape, artifact in ``.bench/``).
+* **Honesty about async dispatch.**  Host-side span times measure
+  *dispatch* wall time, not device time — ``train_one_iter`` returns
+  before the chip finishes (the same hazard the jaxlint
+  ``wallclock-without-sync`` rule flags).  Spans are therefore labeled
+  host-wall; phase-attributed *device* time comes from the profiler
+  trace (:mod:`lightgbm_tpu.obs.device_time`), never from host timers.
+* **No jax import at module import.**  Tools (benchdiff, jaxlint) read
+  telemetry data structures without paying a jax import; the compile
+  counter bridges to :mod:`lightgbm_tpu.analysis.recompile` lazily.
+
+Counters maintained by the library itself:
+
+* ``backend_compiles`` — XLA backend compiles (snapshot-time bridge to
+  ``analysis/recompile.py``'s process-wide listener; cache hits are 0).
+* ``grow_traces`` / ``dp_grow_traces`` — retraces of the serial /
+  data-parallel grow program (incremented at Python trace time inside
+  the traced body, so each retrace counts exactly once).
+* ``host_syncs`` — deliberate device->host materialization points the
+  library performs (eval fetches, lagged-stop drains, bench syncs).
+* ``collective_ops`` / ``collective_bytes`` — cross-device collectives
+  in compiled parallel programs, recorded via :func:`record_collectives`
+  (static count from the optimized HLO, promoted from the old
+  ``tools/collective_count.py``).
+
+Env: ``LGBM_TPU_TELEMETRY`` = ``on`` (default) | ``off`` | ``json``
+(``json`` additionally emits one structured JSON line to stderr when an
+entry point calls :func:`emit`).  Read once at import (jit caches do
+not key on env — same convention the env-read-at-trace rule enforces);
+:func:`set_enabled` is the runtime override the overhead A/B uses.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import threading
+import time
+from os import environ as _environ
+from typing import Dict, List, Optional
+
+# read once at import — see module docstring
+TELEMETRY_MODE = _environ.get("LGBM_TPU_TELEMETRY", "on").strip().lower()
+
+_RESERVOIR_CAP = 4096
+
+
+class SpanStat:
+    """Accumulated wall time of one named span (host-wall, see module
+    docstring for the async-dispatch caveat)."""
+
+    __slots__ = ("total_s", "count", "min_s", "max_s")
+
+    def __init__(self) -> None:
+        self.total_s = 0.0
+        self.count = 0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+
+    def add(self, dt: float) -> None:
+        self.total_s += dt
+        self.count += 1
+        if dt < self.min_s:
+            self.min_s = dt
+        if dt > self.max_s:
+            self.max_s = dt
+
+    def as_dict(self) -> dict:
+        return {
+            "total_s": round(self.total_s, 6),
+            "count": self.count,
+            "min_s": round(self.min_s, 6) if self.count else 0.0,
+            "max_s": round(self.max_s, 6),
+        }
+
+
+class Reservoir:
+    """Sliding window of the most recent ``cap`` samples with p50/p99.
+
+    A ring buffer, not a probabilistic reservoir: per-tree times drift
+    (lazy Mosaic compiles early, steady state later), and the question
+    the manifest answers is "what does a tree cost NOW", so the window
+    deliberately reports the most recent ``cap`` trees.  The total
+    sample count is kept so a reader can see how much was windowed out.
+    """
+
+    __slots__ = ("cap", "_buf", "_n")
+
+    def __init__(self, cap: int = _RESERVOIR_CAP) -> None:
+        self.cap = cap
+        self._buf: List[float] = []
+        self._n = 0
+
+    def add(self, v: float) -> None:
+        if len(self._buf) < self.cap:
+            self._buf.append(v)
+        else:
+            self._buf[self._n % self.cap] = v
+        self._n += 1
+
+    def __len__(self) -> int:
+        return self._n
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the current window (0 if empty)."""
+        if not self._buf:
+            return 0.0
+        s = sorted(self._buf)
+        k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
+        return s[k]
+
+    def as_dict(self) -> dict:
+        window = len(self._buf)
+        mean = sum(self._buf) / window if window else 0.0
+        return {
+            "count": self._n,
+            "window": window,
+            "mean_s": round(mean, 6),
+            "p50_s": round(self.percentile(50), 6),
+            "p99_s": round(self.percentile(99), 6),
+            "max_s": round(max(self._buf), 6) if window else 0.0,
+        }
+
+
+class _Span:
+    """Context manager recording one timed region into a Telemetry."""
+
+    __slots__ = ("_tel", "_name", "_t0")
+
+    def __init__(self, tel: "Telemetry", name: str) -> None:
+        self._tel = tel
+        self._name = name
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tel._record_span(self._name, time.perf_counter() - self._t0)
+
+
+class _NullSpan:
+    """Telemetry-off span: enter/exit do nothing at all."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Telemetry:
+    """Process-wide telemetry store (counters, spans, reservoirs).
+
+    Increment paths rely on the GIL for consistency (a torn telemetry
+    count is acceptable; a lock on the hot path is not); the lock only
+    guards snapshot/reset so a concurrent reader sees a coherent copy.
+    """
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._spans: Dict[str, SpanStat] = {}
+        self._reservoirs: Dict[str, Reservoir] = {}
+
+    # ------------------------------------------------------------- record
+    def span(self, name: str):
+        """``with tel.span("bench.timed_loop"): ...`` — host-wall timer."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def _record_span(self, name: str, dt: float) -> None:
+        st = self._spans.get(name)
+        if st is None:
+            st = self._spans.setdefault(name, SpanStat())
+        st.add(dt)
+
+    def count(self, name: str, n: float = 1) -> None:
+        """Monotonic counter add (no-op when disabled)."""
+        if self.enabled:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def record_value(self, name: str, v: float) -> None:
+        """Append one sample to the named reservoir (e.g. per-tree s)."""
+        if not self.enabled:
+            return
+        r = self._reservoirs.get(name)
+        if r is None:
+            r = self._reservoirs.setdefault(name, Reservoir())
+        r.add(v)
+
+    def host_sync(self, n: int = 1) -> None:
+        """Record a deliberate device->host materialization point."""
+        self.count("host_syncs", n)
+
+    # ------------------------------------------------------------ inspect
+    def counter(self, name: str) -> float:
+        return self._counters.get(name, 0)
+
+    def reservoir(self, name: str) -> Optional[Reservoir]:
+        return self._reservoirs.get(name)
+
+    def span_stat(self, name: str) -> Optional[SpanStat]:
+        return self._spans.get(name)
+
+    def snapshot(self, include_compiles: bool = True) -> dict:
+        """Coherent copy of everything, as plain JSON-able dicts.
+
+        ``backend_compiles`` is bridged in from the analysis subsystem's
+        process-wide listener at snapshot time (importing jax only if
+        the process already did — the listener installs on first use by
+        whoever counts compiles, and a process that never imported jax
+        has by definition compiled nothing).
+        """
+        with self._lock:
+            counters = dict(self._counters)
+            spans = {k: v.as_dict() for k, v in self._spans.items()}
+            reservoirs = {k: v.as_dict() for k, v in self._reservoirs.items()}
+        if include_compiles and "jax" in sys.modules:
+            try:
+                from lightgbm_tpu.analysis.recompile import (
+                    backend_compile_count)
+
+                counters["backend_compiles"] = backend_compile_count()
+            except Exception:
+                pass
+        return {"counters": counters, "spans": spans,
+                "reservoirs": reservoirs}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._spans.clear()
+            self._reservoirs.clear()
+
+    def emit(self, stream=None) -> None:
+        """One JSON line of the full snapshot (``LGBM_TPU_TELEMETRY=json``
+        consumers; also the ``verbose>=2`` structured tail)."""
+        stream = sys.stderr if stream is None else stream
+        print(json.dumps({"lgbm_tpu_telemetry": self.snapshot()},
+                         sort_keys=True),
+              file=stream, flush=True)
+
+
+_TELEMETRY = Telemetry(enabled=TELEMETRY_MODE != "off")
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide singleton every entry point snapshots."""
+    return _TELEMETRY
+
+
+def set_enabled(flag: bool) -> None:
+    """Runtime enable/disable (the overhead A/B measurement switch)."""
+    _TELEMETRY.enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _TELEMETRY.enabled
+
+
+# module-level conveniences bound to the singleton
+def span(name: str):
+    return _TELEMETRY.span(name)
+
+
+def count(name: str, n: float = 1) -> None:
+    _TELEMETRY.count(name, n)
+
+
+def record_value(name: str, v: float) -> None:
+    _TELEMETRY.record_value(name, v)
+
+
+def host_sync(n: int = 1) -> None:
+    _TELEMETRY.host_sync(n)
+
+
+def emit_if_json(stream=None) -> None:
+    """Emit the snapshot line iff LGBM_TPU_TELEMETRY=json (entry points
+    call this unconditionally at the end of a run)."""
+    if TELEMETRY_MODE == "json":
+        _TELEMETRY.emit(stream)
+
+
+# ------------------------------------------------------- collectives (HLO)
+# Promoted from tools/collective_count.py: static collective count +
+# payload bytes of a compiled program's optimized HLO.  The count is per
+# compiled module; the while-body computation (executed num_leaves-1
+# times per tree) is the per-split budget documented in
+# parallel/data_parallel.py.
+
+COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)\b"
+)
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]+)\[([0-9,]*)\]")
+_DT_BYTES = {"f32": 4, "f64": 8, "s32": 4, "u32": 4, "pred": 1, "bf16": 2,
+             "s8": 1, "u8": 1, "f16": 2, "s64": 8, "u64": 8, "u16": 2,
+             "s16": 2}
+
+
+def _collective_bytes_of(line: str) -> int:
+    """Sum ALL result-shape components: variadic (combined) collectives
+    have tuple results like ``(f32[64,32], s32[4]) all-reduce(...)``."""
+    lhs = line.split("=", 1)[-1]
+    m_op = COLLECTIVE_RE.search(lhs)
+    head = lhs[: m_op.start()] if m_op else lhs
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(head):
+        num = 1
+        for d in dims.split(","):
+            if d:
+                num *= int(d)
+        total += num * _DT_BYTES.get(dt, 4)
+    return total
+
+
+def collective_stats(hlo_text: str) -> dict:
+    """Collective ops in an optimized-HLO dump, per computation.
+
+    Returns ``{"total": N, "payload_bytes": B, "by_op": {...},
+    "by_computation": {name: {"ops": {...}, "payload_bytes": B}}}``.
+    ``-done`` halves of async pairs are not double-counted.
+    """
+    blocks: Dict[str, List[str]] = {}
+    cur = None
+    for line in hlo_text.splitlines():
+        if line and not line.startswith(" ") and "{" in line:
+            cur = line.split("{")[0].strip().split(" ")[0]
+            blocks[cur] = []
+        elif cur is not None:
+            blocks[cur].append(line)
+    by_comp: Dict[str, dict] = {}
+    by_op: Dict[str, int] = {}
+    total = 0
+    payload = 0
+    for name, lines in blocks.items():
+        counts: Dict[str, int] = {}
+        nbytes = 0
+        for ln in lines:
+            m = COLLECTIVE_RE.search(ln)
+            if m and "=" in ln and "-done" not in ln.split("=", 1)[-1][:40]:
+                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+                nbytes += _collective_bytes_of(ln)
+        if counts:
+            by_comp[name] = {"ops": counts, "payload_bytes": nbytes}
+            for op, c in counts.items():
+                by_op[op] = by_op.get(op, 0) + c
+            total += sum(counts.values())
+            payload += nbytes
+    return {"total": total, "payload_bytes": payload, "by_op": by_op,
+            "by_computation": by_comp}
+
+
+def record_collectives(tag: str, compiled) -> dict:
+    """Count collectives in a compiled program (``jax.jit(f).lower(*a)
+    .compile()``) and fold them into the telemetry counters
+    (``collective_ops`` / ``collective_bytes``).  Returns the stats."""
+    stats = collective_stats(compiled.as_text())
+    _TELEMETRY.count("collective_ops", stats["total"])
+    _TELEMETRY.count("collective_bytes", stats["payload_bytes"])
+    _TELEMETRY.count(f"collective_ops.{tag}", stats["total"])
+    return stats

@@ -21,8 +21,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.device_time import phase_scope
+
 
 @functools.partial(jax.jit, static_argnames=("num_bins",))
+@phase_scope("histogram")
 def histogram_feature_major(
     bins_T: jax.Array,  # [F, n] integer bins, feature-major
     grad: jax.Array,  # [n]
@@ -42,6 +45,7 @@ def histogram_feature_major(
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "num_leaves"))
+@phase_scope("histogram")
 def histogram_by_leaf(
     bins_T: jax.Array,  # [F, n]
     leaf_id: jax.Array,  # [n] current leaf per row

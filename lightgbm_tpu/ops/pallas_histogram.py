@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.device_time import phase_scope
+
 DEFAULT_CHUNK = 1024
 FGROUP = 8  # feature rows per kernel loop step (int8 sublane-pack aligned)
 # bsub feature-group block height: the [C, 4] stats block is re-fetched
@@ -227,6 +229,7 @@ def _hist_pallas_call(
     jax.jit,
     static_argnames=("num_bins", "num_leaves", "chunk", "interpret", "variant"),
 )
+@phase_scope("histogram")
 def histogram_by_leaf_sorted(
     bins_T: jax.Array,  # [F, n] uint8/uint16 binned matrix, feature-major
     leaf_id: jax.Array,  # [n] int32 leaf per row
@@ -302,6 +305,7 @@ def histogram_by_leaf_sorted(
 @functools.partial(
     jax.jit, static_argnames=("num_bins", "chunk", "interpret", "variant")
 )
+@phase_scope("histogram")
 def histogram_single_leaf(
     bins_T: jax.Array,  # [F, cap] binned rows of ONE leaf (masked)
     grad: jax.Array,  # [cap]
@@ -358,6 +362,7 @@ def _prep_single_leaf(bins_T, grad, hess, mask, num_bins, chunk, fg):
 @functools.partial(
     jax.jit, static_argnames=("num_bins", "chunk", "interpret")
 )
+@phase_scope("histogram")
 def histogram_single_leaf_raw(
     bins_T: jax.Array,  # [F, cap] binned rows of ONE leaf (masked)
     grad: jax.Array,  # [cap]

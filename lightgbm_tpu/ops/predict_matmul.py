@@ -46,6 +46,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.device_time import phase_scope
+
 _FLT_MAX = jnp.float32(3.4028235e38)
 
 
@@ -59,6 +61,7 @@ def _sanitize(X):
 
 
 @jax.jit
+@phase_scope("predict")
 def build_path_tables(stacked):
     """Per-tree path-incidence tables from a stacked Tree pytree
     (leading axis [T], or [n_iter, K] — mirrored in the outputs):
@@ -146,6 +149,7 @@ def _tree_hit(X, feat, thr, is_cat, M, base, depth, valid):
 
 
 @jax.jit
+@phase_scope("predict")
 def ensemble_sum_matmul(tables, stacked, X):
     """Σ over trees of per-row outputs on RAW features; ``stacked`` and
     each table carry leading axes [n_iter, K]; returns [K, n].  Same
@@ -172,6 +176,7 @@ def ensemble_sum_matmul(tables, stacked, X):
 
 
 @jax.jit
+@phase_scope("predict")
 def ensemble_leaves_matmul(tables, stacked, X):
     """Per-tree leaf indices on raw features (flat leading axis [T]) ->
     [T, n] int32 — contract of models/tree.py ensemble_leaves_raw."""

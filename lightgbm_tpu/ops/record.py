@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.device_time import phase_scope
+
 import os as _os
 
 # partition tile width; larger tiles halve the placement-scan step count
@@ -114,6 +116,7 @@ def pack_bins(bins_T: jax.Array, n_pad: int) -> jax.Array:
     return out
 
 
+@phase_scope("partition")
 def build_record(
     bins_T: jax.Array,  # [F, n] u8/u16
     grad: jax.Array,  # [n] f32
@@ -443,6 +446,7 @@ def _write_window_kernel(scal_ref, prev_ref, cur_ref, rec_in_ref,
 ALIASED_WRITEBACK = _os.environ.get("LGBM_TPU_ALIASED_WRITEBACK", "1") != "0"
 
 
+@phase_scope("partition")
 def write_window(rec, out_win, begin, cap: int, interpret: bool = False):
     """rec[:, begin:begin+cap] = out_win, with rec aliased in place so
     the record threads tier-cond boundaries copy-free (the round-4
@@ -736,6 +740,7 @@ def _place_table(begin, pcnt, nleft, cl, cr, loff, roff,
     jax.jit, static_argnames=("cap", "leaf_row", "interpret"),
     donate_argnums=(0,),
 )
+@phase_scope("partition")
 def place_runs(
     rec,  # [W, n_pad] i32 — DONATED, aliased in place
     comp,  # [nt, W, 2T] i32 — the split kernel's compacted tiles
@@ -816,6 +821,7 @@ def place_runs(
                      "interpret"),
     donate_argnums=(0,),
 )
+@phase_scope("split_step")
 def split_step_window(
     hists,  # [P, Fp, 4, Bp] f32 — DONATED, rows updated in place
     rec,  # [W, n_pad] i32
@@ -973,6 +979,7 @@ def split_step_window(
 
 @functools.partial(
     jax.jit, static_argnames=("cap", "leaf_row", "direct", "interpret"))
+@phase_scope("partition")
 def partition_window(
     rec: jax.Array,  # [W, n_pad] i32 (aliased in-kernel when direct)
     go: jax.Array,  # [cap] i32: left-going (valid rows only)

@@ -30,6 +30,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.device_time import phase_scope
+
 K_EPSILON = 1e-15
 K_MIN_SCORE = -jnp.inf
 
@@ -63,6 +65,7 @@ def _leaf_output(sum_grad, sum_hess, l1, l2):
 
 
 @functools.partial(jax.jit, static_argnames=())
+@phase_scope("split-search")
 def find_best_split(
     hist: jax.Array,  # [F, B, 3] (sum_grad, sum_hess, count) for one leaf
     sum_grad: jax.Array,  # scalar leaf totals (bookkept, not re-summed)

@@ -53,6 +53,7 @@ from ..compat import shard_map
 from ..learners.depthwise import grow_tree_depthwise
 from ..learners.hybrid import HYBRID_STOP_FACTOR
 from ..learners.serial import grow_tree
+from ..obs import telemetry
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from ..ops.split import SplitResult, find_best_split
 from .mesh import ROW_AXIS, row_padded_grower
@@ -91,6 +92,8 @@ def data_parallel_sharded(
         return jax.lax.psum(x, axis)
 
     def shard_body(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
+        # trace-time retrace counter (obs; see serial.grow_tree)
+        telemetry.count("dp_grow_traces")
         F = bins_T.shape[0]
         Fs = -(-F // num_shards)  # feature-shard width of the scattered hist
         pad = Fs * num_shards - F

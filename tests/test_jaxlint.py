@@ -197,12 +197,55 @@ def test_line_pragma_suppresses():
         src, path="lightgbm_tpu/models/gbdt.py")
 
 
+def test_wallclock_without_sync_fires():
+    # the async-dispatch mis-timing hazard: jnp work between the start
+    # mark and the stop timestamp, nothing blocking before the stop
+    src = """
+    import time
+    import jax.numpy as jnp
+
+    def timed_step(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        return y, time.perf_counter() - t0
+    """
+    assert "wallclock-without-sync" in _rules(src)
+
+
+def test_wallclock_with_sync_or_host_only_is_fine():
+    src = """
+    import time
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def timed_synced(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        jax.block_until_ready(y)
+        return y, time.perf_counter() - t0
+
+    def timed_via_asarray(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        out = np.asarray(y)
+        return out, time.perf_counter() - t0
+
+    def host_only(n):
+        t0 = time.perf_counter()
+        s = sum(range(n))
+        return s, time.perf_counter() - t0
+    """
+    assert "wallclock-without-sync" not in _rules(src)
+
+
 def test_rule_table_complete():
     # every rule the walker can emit is documented (CLI --list-rules)
     assert set(AST_RULES) == {
         "host-sync-in-jit", "python-loop-over-device-array",
         "env-read-at-trace", "f64-literal-in-traced",
         "jit-cache-miss-risk", "host-sync-in-loop",
+        "wallclock-without-sync",
     }
 
 

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""benchdiff: compare two bench results and flag regressions.
+
+The round-5 failure mode this tool ends: BENCH_r05 (0.4442 s/tree,
+vs_baseline 0.71) was committed next to BENCH_r04 (0.3713 / 1.087) and
+nobody diffed them.  ``benchdiff`` normalizes any two result artifacts,
+compares headline + phases + compile hygiene against thresholds, and
+prints the driver-config bench row ROADMAP item 1 requires in any
+perf-motivated serial.py/record.py commit.
+
+Accepted input formats (auto-detected per file):
+
+* driver BENCH artifacts  (``BENCH_r0N.json`` — ``{"parsed": {...}}``)
+* raw bench.py rows       (``{"metric": ..., "value": ...}``)
+* run manifests           (``*.manifest.json`` — obs.manifest v1; the
+  headline comes from ``result``, phases from ``phases``)
+
+Usage:
+    python tools/benchdiff.py OLD NEW [--threshold PCT]
+        [--phase-threshold PCT] [--json OUT]
+
+Exit codes (diff semantics): 0 = no regression, 1 = regression flagged,
+2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+# default thresholds (percent).  Headline: the acceptance bar is
+# "+>=15% s/tree is a regression"; phases get more slack because
+# per-phase attribution carries trace sampling noise.
+HEADLINE_PCT = 15.0
+PHASE_PCT = 25.0
+AUC_ABS = 0.002  # an AUC drop is a correctness smell, not a perf one
+
+MANIFEST_SCHEMA = "lightgbm-tpu/run-manifest/v1"
+
+
+def _load(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def normalize(path: str) -> dict:
+    """One record shape for every accepted input format:
+    ``{label, value, unit, vs_baseline, auc..., phases, compile...}``."""
+    raw = _load(path)
+    rec: dict = {"label": os.path.basename(path), "path": path,
+                 "phases": {}, "sha": None}
+    if raw.get("schema") == MANIFEST_SCHEMA:
+        row = dict(raw.get("result") or {})
+        rec["phases"] = dict(raw.get("phases") or {})
+        rec["sha"] = (raw.get("git") or {}).get("sha")
+        rec["per_tree"] = raw.get("per_tree") or {}
+        rec["warmup"] = raw.get("warmup") or {}
+        # northstar manifests carry the headline under another key
+        if "value" not in row and "steady_sec_per_tree" in row:
+            row["value"] = row["steady_sec_per_tree"]
+            row.setdefault("unit", "s/tree")
+        # cli.train manifests record wall + tree count: synthesize the
+        # s/tree headline so any two run manifests really are diffable
+        # (README's promise)
+        if "value" not in row and row.get("train_wall_s") \
+                and row.get("num_trees"):
+            row["value"] = float(row["train_wall_s"]) / row["num_trees"]
+            row.setdefault("unit", "s/tree (wall, incl. compile)")
+    elif "parsed" in raw:  # driver BENCH artifact
+        row = dict(raw["parsed"] or {})
+    else:  # raw bench.py row
+        row = dict(raw)
+    for k in ("metric", "value", "unit", "vs_baseline", "platform",
+              "growth", "train_auc", "valid_auc", "knobs", "error",
+              "warmup_iters", "warm_trees_discarded", "compile_stable",
+              "compiles_warmup", "compiles_timed", "timed_trees"):
+        if k in row:
+            rec[k] = row[k]
+    if "phases" in row and not rec["phases"]:
+        rec["phases"] = dict(row["phases"] or {})
+    if rec.get("value") in (None, 0, 0.0) and "error" not in row:
+        # a zero headline is an unusable record, not a 100% improvement
+        raise ValueError(f"{path}: no usable headline value in {row}")
+    return rec
+
+
+def _pct(old: float, new: float) -> float:
+    return (new - old) / old * 100.0 if old else float("inf")
+
+
+def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
+         phase_pct: float = PHASE_PCT) -> dict:
+    """Compare two normalized records; returns
+    ``{regressions: [...], warnings: [...], improvements: [...],
+    headline: {...}}``."""
+    regressions, warnings, improvements = [], [], []
+
+    if old.get("metric") and new.get("metric") \
+            and old["metric"] != new["metric"]:
+        warnings.append(
+            f"metric mismatch: {old['metric']} vs {new['metric']} — "
+            "comparison may not be apples-to-apples")
+
+    # an errored/empty NEW run is the worst regression of all, not a
+    # -100% improvement (bench.py's crash path emits value 0.0 + error)
+    if new.get("error"):
+        regressions.append(f"NEW run errored: {new['error']}")
+    if old.get("error"):
+        warnings.append(f"OLD run errored: {old['error']} — baseline "
+                        "side is not a real measurement")
+    ov, nv = float(old.get("value") or 0), float(new.get("value") or 0)
+    headline = {"old_s_per_tree": ov, "new_s_per_tree": nv,
+                "delta_pct": None}
+    if nv <= 0 and not new.get("error"):
+        regressions.append("NEW run has no headline value")
+    if ov > 0 and nv > 0:
+        head = _pct(ov, nv)
+        headline["delta_pct"] = round(head, 1)
+        if head >= headline_pct:
+            regressions.append(
+                f"headline s/tree {ov:.4f} -> {nv:.4f} "
+                f"(+{head:.1f}%, threshold +{headline_pct:.0f}%)")
+        elif head <= -headline_pct:
+            improvements.append(
+                f"headline s/tree {ov:.4f} -> {nv:.4f} ({head:.1f}%)")
+
+    ovb, nvb = old.get("vs_baseline"), new.get("vs_baseline")
+    if ovb and nvb:
+        headline["vs_baseline"] = {"old": ovb, "new": nvb}
+        if float(nvb) < 0.85 * float(ovb):
+            regressions.append(
+                f"vs_baseline {ovb} -> {nvb} "
+                f"({_pct(float(ovb), float(nvb)):.1f}%)")
+
+    # per-phase regressions: only comparable when both runs attributed
+    # phases (a missing breakdown is reported, never silently skipped)
+    op, np_ = old.get("phases") or {}, new.get("phases") or {}
+    shared = sorted(set(op) & set(np_) - {"unattributed"})
+    if op or np_:
+        if not shared:
+            warnings.append("phase breakdowns not comparable "
+                            f"(old: {sorted(op)}, new: {sorted(np_)})")
+        # a phase present on only ONE side is itself a signal (lost
+        # scope attribution, or work that moved to/from unattributed)
+        # — never drop it silently
+        for ph in sorted(set(op) ^ set(np_)):
+            side = "old" if ph in op else "new"
+            val = op.get(ph, np_.get(ph, 0.0))
+            warnings.append(
+                f"phase '{ph}' ({val:.3f}s) present only in the {side} "
+                "run — attribution changed between the two runs")
+        for ph in shared:
+            o, n = float(op[ph]), float(np_[ph])
+            if o <= 0 or n <= 0:
+                # a 0.0 side has no meaningful percent (bucket_events
+                # keeps 0.0-second entries); only a real appearance is
+                # worth a word
+                if max(o, n) > 0.05:
+                    warnings.append(
+                        f"phase '{ph}' {o:.3f}s -> {n:.3f}s (no "
+                        "baseline to diff against)")
+                continue
+            d = _pct(o, n)
+            if d >= phase_pct:
+                regressions.append(
+                    f"phase '{ph}' {o:.3f}s -> {n:.3f}s "
+                    f"(+{d:.1f}%, threshold +{phase_pct:.0f}%)")
+            elif d <= -phase_pct:
+                improvements.append(
+                    f"phase '{ph}' {o:.3f}s -> {n:.3f}s ({d:.1f}%)")
+    else:
+        warnings.append("no phase breakdown on either side (capture one "
+                        "with LGBM_TPU_TRACE=<dir> bench.py)")
+
+    # compile hygiene of the NEW run (the round-5 mechanism: lazy
+    # compiles inside the timed loop)
+    if new.get("compiles_timed"):
+        regressions.append(
+            f"{new['compiles_timed']} backend compile(s) inside the NEW "
+            "run's timed loop — the measurement itself is dirty")
+    if new.get("compile_stable") is False:
+        warnings.append("NEW run's warm-up never went compile-stable "
+                        "(BENCH_MAX_WARM exhausted)")
+
+    for k in ("train_auc", "valid_auc"):
+        if old.get(k) is not None and new.get(k) is not None:
+            d = float(new[k]) - float(old[k])
+            if d < -AUC_ABS:
+                regressions.append(f"{k} {old[k]} -> {new[k]} ({d:+.4f})")
+
+    return {"headline": headline, "regressions": regressions,
+            "warnings": warnings, "improvements": improvements}
+
+
+def driver_row(rec: dict) -> str:
+    """The bench row ROADMAP item 1 requires in perf-motivated
+    serial.py/record.py commits — ready to paste."""
+    sha = (rec.get("sha") or "unknown")[:9]
+    knobs = ",".join(f"{k.split('LGBM_TPU_')[-1]}={v}"
+                     for k, v in (rec.get("knobs") or {}).items()) or "-"
+    return ("| {metric} | {value} s/tree | vs_baseline {vsb} | "
+            "{platform} | warm {w}/{d} compiles {cw}+{ct} | {knobs} | "
+            "{sha} |").format(
+        metric=rec.get("metric", "?"), value=rec.get("value", "?"),
+        vsb=rec.get("vs_baseline", "?"),
+        platform=rec.get("platform", "?"),
+        w=rec.get("warmup_iters", "?"),
+        d=rec.get("warm_trees_discarded", "?"),
+        cw=rec.get("compiles_warmup", "?"),
+        ct=rec.get("compiles_timed", "?"),
+        knobs=knobs, sha=sha)
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=HEADLINE_PCT,
+                    help="headline regression threshold in percent "
+                         f"(default {HEADLINE_PCT:.0f})")
+    ap.add_argument("--phase-threshold", type=float, default=PHASE_PCT,
+                    help="per-phase regression threshold in percent "
+                         f"(default {PHASE_PCT:.0f})")
+    ap.add_argument("--json", help="also write the full report here")
+    args = ap.parse_args(argv)
+
+    try:
+        old, new = normalize(args.old), normalize(args.new)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"benchdiff: {e}", file=sys.stderr)
+        return 2
+
+    report = diff(old, new, args.threshold, args.phase_threshold)
+    h = report["headline"]
+    print(f"benchdiff: {old['label']} -> {new['label']}")
+    delta = ("n/a" if h["delta_pct"] is None
+             else f"{h['delta_pct']:+.1f}%")
+    print(f"  headline: {h['old_s_per_tree']:.4f} -> "
+          f"{h['new_s_per_tree']:.4f} s/tree ({delta})")
+    for r in report["regressions"]:
+        print(f"  REGRESSION: {r}")
+    for w in report["warnings"]:
+        print(f"  warning: {w}")
+    for i in report["improvements"]:
+        print(f"  improvement: {i}")
+    print("  driver-config row (paste into the commit message):")
+    print("  " + driver_row(new))
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump({"old": old, "new": new, "report": report}, fh,
+                      indent=1)
+    # diff semantics: 1 means "differences (regressions) found"
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,8 +4,13 @@ Compiles the leaf-wise data-parallel grower over an 8-device virtual CPU
 mesh and counts collective ops in the optimized HLO — the evidence for
 the per-split collective budget documented in parallel/data_parallel.py.
 
-The ops sit inside the fori_loop body (executed num_leaves-1 times per
-tree), so the per-split budget is the count within the while body.
+The counting itself lives in the library now
+(``lightgbm_tpu.obs.telemetry.collective_stats`` /
+``record_collectives`` — promoted from this tool so parallel runs can
+fold collective counts into their telemetry); this CLI keeps the
+human-readable per-computation report.  The ops sit inside the
+fori_loop body (executed num_leaves-1 times per tree), so the per-split
+budget is the count within the while body.
 
 Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
             python tools/collective_count.py
@@ -14,7 +19,6 @@ Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -37,61 +41,19 @@ import numpy as np  # noqa: E402
 
 from lightgbm_tpu.config import Config  # noqa: E402
 from lightgbm_tpu.learners.serial import TreeLearnerParams  # noqa: E402
+from lightgbm_tpu.obs import record_collectives  # noqa: E402
 from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower  # noqa: E402
 
-COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)\b"
-)
 
-
-SHAPE_RE = re.compile(r"([a-z]+[0-9]+)\[([0-9,]*)\]")
-_DT_BYTES = {"f32": 4, "f64": 8, "s32": 4, "u32": 4, "pred": 1, "bf16": 2,
-             "s8": 1, "u8": 1, "f16": 2, "s64": 8, "u64": 8, "u16": 2,
-             "s16": 2}
-
-
-def _bytes_of(line: str) -> int:
-    """Sum ALL result-shape components: variadic (combined) collectives
-    have tuple results like `(f32[64,32], s32[4]) all-reduce(...)`."""
-    lhs = line.split("=", 1)[-1]
-    # result shapes precede the op name; operands repeat shapes, so cut
-    # at the opening paren of the operand list (after the op keyword)
-    m_op = COLLECTIVE_RE.search(lhs)
-    head = lhs[: m_op.start()] if m_op else lhs
-    total = 0
-    for dt, dims in SHAPE_RE.findall(head):
-        num = 1
-        for d in dims.split(","):
-            if d:
-                num *= int(d)
-        total += num * _DT_BYTES.get(dt, 4)
-    return total
-
-
-def report(tag: str, hlo: str) -> None:
+def report(tag: str, compiled) -> None:
     """Per-computation collective counts + payload bytes.  The while body
     (executed num_leaves-1 times) is the per-split budget."""
-    blocks: dict[str, list[str]] = {}
-    cur = None
-    for line in hlo.splitlines():
-        if line and not line.startswith(" ") and "{" in line:
-            cur = line.split("{")[0].strip().split(" ")[0]
-            blocks[cur] = []
-        elif cur is not None:
-            blocks[cur].append(line)
-    for name, lines in blocks.items():
-        counts: dict[str, int] = {}
-        nbytes = 0
-        for ln in lines:
-            m = COLLECTIVE_RE.search(ln)
-            if m and "-done" not in ln.split("=", 1)[-1][:40] and "=" in ln:
-                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
-                nbytes += _bytes_of(ln)
-        if counts:
-            where = "ENTRY (per-tree setup)" if name.startswith("ENTRY") \
-                else f"{name} (per-split while body)"
-            print(f"[{tag}] {where}: {counts}  payload={nbytes}B")
+    stats = record_collectives(tag, compiled)
+    for name, entry in stats["by_computation"].items():
+        where = "ENTRY (per-tree setup)" if name.startswith("ENTRY") \
+            else f"{name} (per-split while body)"
+        print(f"[{tag}] {where}: {entry['ops']}  "
+              f"payload={entry['payload_bytes']}B")
 
 
 def main() -> None:
@@ -109,8 +71,7 @@ def main() -> None:
     )
     mesh = data_mesh()
     grow = make_data_parallel_grower(mesh, num_bins=B, max_leaves=L)
-    report("data-parallel F=64",
-           jax.jit(grow).lower(*args).compile().as_text())
+    report("data-parallel F=64", jax.jit(grow).lower(*args).compile())
 
     # voting-parallel (PV-Tree): the vote restricts the reduced histogram
     # payload from O(F*B) to O(2*top_k*B)
@@ -121,7 +82,7 @@ def main() -> None:
         grow_v = make_voting_parallel_grower(
             mesh, num_bins=B, max_leaves=L, top_k=top_k)
         report(f"voting top_k={top_k} F=64",
-               jax.jit(grow_v).lower(*args).compile().as_text())
+               jax.jit(grow_v).lower(*args).compile())
 
 
 if __name__ == "__main__":

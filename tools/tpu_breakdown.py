@@ -68,6 +68,34 @@ def main():
               f"{t_tree*1000:.0f} ms/tree, AUC {auc:.4f}", flush=True)
         results[growth] = t_tree
 
+        # phase-attributed device time (obs.device_time): a short
+        # profiler trace of 3 steady trees, bucketed into histogram /
+        # split-search / partition / leaf-update.  Default on-TPU only:
+        # op-level attribution needs the TPU profiler plugin (it
+        # exports HLO op_name metadata into event args; the CPU tracer
+        # doesn't — and its per-thunk TraceMe costs ~50x on this grow
+        # loop).  BREAKDOWN_TRACE=1/0 forces either way.
+        want_trace = os.environ.get(
+            "BREAKDOWN_TRACE", "1" if jax.default_backend() == "tpu"
+            else "0") != "0"
+        if want_trace:
+            import tempfile
+
+            from lightgbm_tpu.obs.device_time import trace_phases
+
+            with trace_phases(tempfile.mkdtemp(prefix="lgbm_bd_")) as tr:
+                for _ in range(3):
+                    booster.train_one_iter()
+                _ = np.asarray(booster._scores[0, :1])
+            total = sum(tr.phases.values())
+            if tr.phases and total > 0:
+                parts = ", ".join(
+                    f"{k} {v:.3f}s ({v / total * 100:.0f}%)"
+                    for k, v in sorted(tr.phases.items(),
+                                       key=lambda kv: -kv[1]))
+                print(f"{growth}: device phases over 3 trees: {parts}",
+                      flush=True)
+
     # raw kernel throughput at bench shapes
     from lightgbm_tpu.ops.pallas_histogram import (
         histogram_by_leaf_sorted, histogram_single_leaf)
