@@ -153,6 +153,10 @@ def run_reference_cli(exe: str, data_path: str, model_path: str,
 
 def reference_sec_per_tree(X, y, key: str, Xv=None, yv=None):
     """Returns (sec_per_tree, ref_train_auc, ref_valid_auc)."""
+    # crash-safe cache writes (resilience/atomic.py); imported lazily so
+    # the module keeps its no-package-import-before-backend-pinning rule
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
     os.makedirs(CACHE_DIR, exist_ok=True)
     cache = os.path.join(CACHE_DIR, f"baseline_{key}.json")
     model_path = f"/tmp/bench_ref_model_{key}.txt"  # keyed: a stale or
@@ -176,8 +180,7 @@ def reference_sec_per_tree(X, y, key: str, Xv=None, yv=None):
             except Exception as e:
                 log(f"reference valid-AUC backfill failed: {e}")
         if dirty:
-            with open(cache, "w") as fh:
-                json.dump(data, fh)
+            atomic_write_json(cache, data, indent=None)
         # a valid AUC computed for a DIFFERENT held-out size must never
         # feed this run's parity columns (possible when the model file is
         # gone so the backfill above couldn't refresh it)
@@ -209,15 +212,15 @@ def reference_sec_per_tree(X, y, key: str, Xv=None, yv=None):
             ref_valid_auc = _model_train_auc(model_path, Xv, yv)
         except Exception as e:
             log(f"reference valid-AUC computation failed: {e}")
-    with open(cache, "w") as fh:
-        # ref_valid_auc_rows is only stamped on SUCCESS: a transient
-        # failure must leave the backfill (keyed on rows mismatch) armed
-        json.dump({"sec_per_tree": sec_per_tree, "total_s": total,
-                   "trees": TREES, "rows": ROWS, "ref_auc": ref_auc,
-                   "ref_valid_auc": ref_valid_auc,
-                   "ref_valid_auc_rows":
-                       None if ref_valid_auc is None else len(Xv)},
-                  fh)
+    # ref_valid_auc_rows is only stamped on SUCCESS: a transient
+    # failure must leave the backfill (keyed on rows mismatch) armed
+    atomic_write_json(
+        cache,
+        {"sec_per_tree": sec_per_tree, "total_s": total,
+         "trees": TREES, "rows": ROWS, "ref_auc": ref_auc,
+         "ref_valid_auc": ref_valid_auc,
+         "ref_valid_auc_rows": None if ref_valid_auc is None else len(Xv)},
+        indent=None)
     log(f"reference baseline: {sec_per_tree:.3f}s/tree (total {total:.1f}s, "
         f"train AUC={ref_auc}, valid AUC={ref_valid_auc})")
     return sec_per_tree, ref_auc, ref_valid_auc

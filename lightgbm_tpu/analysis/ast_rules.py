@@ -107,6 +107,14 @@ AST_RULES: Dict[str, str] = {
         "dispatch, not compute (the mis-timing hazard behind every "
         "too-good-to-be-true bench number)"
     ),
+    "raw-artifact-write": (
+        "open(path, 'w'/'x') or json.dump(obj, open(...)) writes an "
+        "artifact non-atomically: a preemption mid-write leaves half a "
+        "file under the real name (a truncated model silently LOADS, "
+        "with fewer trees).  Route result artifacts through "
+        "resilience.atomic_write / atomic_write_json / atomic_writer "
+        "(tmp + fsync + rename); append-mode logs are exempt"
+    ),
 }
 
 _HOT_DIR_PARTS = ("learners", "ops", "parallel")
@@ -399,11 +407,48 @@ class _RuleWalker(ast.NodeVisitor):
                 "read (jit caches do not key on env)",
             )
 
+    # --------------------------------------------- raw-artifact-write
+    @staticmethod
+    def _write_mode_of(call: ast.Call) -> Optional[str]:
+        """The constant mode string of an ``open()`` call when it is a
+        WRITE mode ('w'/'x' family; 'a' append and 'r+' update are
+        exempt — logs and in-place patching are not artifact writes)."""
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            if mode.value and mode.value[0] in ("w", "x"):
+                return mode.value
+        return None
+
+    def _check_raw_write(self, node: ast.Call, name: Optional[str]) -> None:
+        if name == "open" and self._write_mode_of(node) is not None:
+            self.flag(
+                "raw-artifact-write", node,
+                f"open(..., {self._write_mode_of(node)!r}) writes "
+                "non-atomically: a crash mid-write leaves a truncated "
+                "file under the real name — use resilience.atomic_write"
+                "/atomic_writer (tmp + fsync + rename)",
+            )
+        elif name in ("json.dump",) and len(node.args) >= 2:
+            f = node.args[1]
+            if (isinstance(f, ast.Call) and _dotted(f.func) == "open"
+                    and self._write_mode_of(f) is not None):
+                self.flag(
+                    "raw-artifact-write", node,
+                    "json.dump(obj, open(..., 'w')) writes an artifact "
+                    "non-atomically — use resilience.atomic_write_json",
+                )
+
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
         leaf = name.split(".")[-1] if name else None
 
         self._note_wallclock_call(node, name, leaf)
+        self._check_raw_write(node, name)
 
         # env-read-at-trace: os.environ.get(...) / os.getenv(...)
         if self.traced and name in ("os.environ.get", "os.getenv",

@@ -507,7 +507,13 @@ class Booster:
         if isinstance(data, str):
             from .io.parser import parse_file
 
-            raw, _ = parse_file(data, has_header=data_has_header)
+            # STRICT on the prediction path regardless of any training
+            # config: lenient parsing skips rows, and a skipped row
+            # silently shifts every later prediction onto the wrong
+            # input line — raising (the pre-hardening behavior) is the
+            # only row-alignment-safe response here
+            raw, _ = parse_file(data, has_header=data_has_header,
+                                strict=True)
             label_idx = self._gbdt.label_idx
             if raw.shape[1] > self._gbdt.max_feature_idx + 1:
                 data = np.delete(raw, label_idx, axis=1)

@@ -314,7 +314,9 @@ def booster_predict_for_file(handle, data_filename, data_has_header,
         data_has_header=bool(data_has_header),
     )
     arr = np.asarray(pred)
-    with open(result_filename, "w") as fh:
+    from .resilience.atomic import atomic_writer
+
+    with atomic_writer(result_filename) as fh:
         if arr.ndim == 1:
             fh.write("\n".join(repr(float(v)) for v in arr) + "\n")
         else:

@@ -28,11 +28,15 @@ from .models.dart import create_boosting
 from .models.gbdt import GBDT
 from .obs import RunManifest, manifest_path, telemetry
 from .objectives import create_objective
+from .resilience import EXIT_PREEMPTED, atomic_writer
 
 
 def load_parameters(argv: List[str]) -> Dict[str, str]:
     """argv ``key=value`` pairs + optional config file; argv wins
-    (application.cpp:46-104)."""
+    (application.cpp:46-104).  Bare ``--flag`` tokens are accepted as
+    ``flag=true`` (``python -m lightgbm_tpu ... --resume``)."""
+    argv = [a[2:] + "=true" if a.startswith("--") and "=" not in a
+            else a.lstrip("-") for a in argv]
     # Canonicalize alias keys BEFORE merging so argv wins across aliases
     # too (argv ``valid=`` must override a conf-file ``valid_data=``),
     # matching the reference's alias transform + priority merge
@@ -62,10 +66,10 @@ class Predictor:
 
     def predict_file(self, data_path: str, result_path: str, has_header: bool = False,
                      num_iteration: int = -1) -> None:
-        # write through a temp file: a failing predict must not destroy
-        # an existing result file by truncating it up front
-        tmp_path = result_path + ".tmp"
-        with open(tmp_path, "w") as fh:
+        # crash-safe streaming write (resilience/atomic.py): a failing
+        # or preempted predict must neither destroy an existing result
+        # file nor leave a truncated one under the real name
+        with atomic_writer(result_path) as fh:
             for out in self._predict_chunks(
                 data_path, has_header, num_iteration
             ):
@@ -76,7 +80,6 @@ class Predictor:
                 else:
                     for row in out:
                         fh.write("\t".join(f"{v:.9g}" for v in row) + "\n")
-        os.replace(tmp_path, result_path)
 
     def _predict_chunks(self, data_path, has_header, num_iteration):
         """Stream large CSV/TSV predict inputs chunk by chunk (the
@@ -189,6 +192,29 @@ def run_train(cfg: Config) -> GBDT:
     best_iter: Dict[tuple, int] = {}
     best_model_iter = 0
 
+    # checkpoint resume (resilience/checkpoint.py): restore the EXACT
+    # training state — trees, score buffers, RNGs, bagging mask, early-
+    # stop bests — so the final model is bitwise-identical to an
+    # uninterrupted run.  Validation (checksum, config fingerprint) is
+    # loud; only "no checkpoint exists yet" silently starts fresh (a
+    # preemption before the first snapshot loses nothing).
+    from .resilience import checkpoint as ckpt
+
+    start_iter = 0
+    if cfg.resume:
+        found = ckpt.load_latest_for(cfg)
+        if found is not None:
+            ck_path, payload = found
+            start_iter = ckpt.restore_training_state(
+                booster, payload, best_score, best_iter)
+            Log.info(
+                f"Resumed from {ck_path}: {booster.num_trees} trees, "
+                f"continuing at iteration {start_iter + 1}")
+        else:
+            Log.warning(
+                "resume=true but no checkpoint found in "
+                f"{ckpt.checkpoint_dir(cfg)}; starting fresh")
+
     profiler_ctx = None
     if cfg.profile:
         # TPU-native replacement for the reference's per-iteration
@@ -202,14 +228,19 @@ def run_train(cfg: Config) -> GBDT:
     start = time.perf_counter()
     stop_iter = None
     try:
-        stop_iter = _train_loop(cfg, booster, valid_names, best_score,
-                                best_iter, start)
+        with ckpt.CheckpointManager(cfg, booster, best_score, best_iter) as ckmgr:
+            stop_iter = _train_loop(cfg, booster, valid_names, best_score,
+                                    best_iter, start, start_iter, ckmgr)
     finally:
         if profiler_ctx is not None:
             import jax
 
             jax.profiler.stop_trace()
             Log.info(f"Saved profiler trace to {profiler_ctx}")
+    # drain the non-finite guard's lazy counters BEFORE the model save
+    # and manifest snapshot, so nonfinite_values_clipped is accurate in
+    # both (short clip-policy runs would otherwise report 0)
+    booster.finalize_guards()
     stop_early = stop_iter is not None
     if stop_early:
         best_model_iter = stop_iter + 1
@@ -262,15 +293,21 @@ def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
 
 
 def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
-                best_score: Dict, best_iter: Dict, start: float):
+                best_score: Dict, best_iter: Dict, start: float,
+                start_iter: int = 0, ckmgr=None):
     """The iteration loop (application.cpp:223-239); returns the best
     0-based iteration when early stopping fired, else None.
 
     Early stopping matches the reference (gbdt.cpp:336-349): it fires as
     soon as ANY (valid set, metric) pair has gone early_stopping_round
     iterations without improving, and the model is truncated to THAT
-    pair's best iteration — not the max over all pairs."""
-    for it in range(cfg.num_iterations):
+    pair's best iteration — not the max over all pairs.
+
+    ``ckmgr.after_iteration`` runs once per completed iteration: it
+    writes due snapshots and, after a SIGTERM/SIGINT, checkpoints and
+    raises TrainingPreempted (the in-flight iteration always finishes
+    first — a half-grown tree is not a resumable state)."""
+    for it in range(start_iter, cfg.num_iterations):
         finished = booster.train_one_iter()
         Log.info(
             f"{time.perf_counter() - start:.6f} seconds elapsed, "
@@ -297,6 +334,11 @@ def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
             Log.info("Stopped training because there are no more leaves "
                      "that meet the split requirements.")
             break
+        # AFTER the metric/early-stop bookkeeping: a checkpoint at
+        # iteration k must carry k's best-score updates or a resumed
+        # run's early stopping would diverge from the uninterrupted one
+        if ckmgr is not None:
+            ckmgr.after_iteration(it)
     # drain the lagged stop check when the loop ended by iteration count
     # (no-op unless LGBM_TPU_STOP_LAG is set)
     booster.finish_lagged_stop()
@@ -334,6 +376,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         jax.config.update("jax_platforms", plat)
     argv = sys.argv[1:] if argv is None else list(argv)
+    from .resilience.checkpoint import TrainingPreempted
+
     try:
         params = load_parameters(argv)
         cfg = Config.from_dict(params)
@@ -344,6 +388,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             run_predict(cfg)
         else:
             Log.fatal(f"Unknown task: {cfg.task!r}")
+    except TrainingPreempted as ex:
+        # distinct exit status (sysexits EX_TEMPFAIL): the supervisor
+        # re-launches with resume=true and loses nothing
+        print(f"Preempted:\n{ex}", file=sys.stderr)
+        return EXIT_PREEMPTED
     except Exception as ex:
         print(f"Met Exceptions:\n{ex}", file=sys.stderr)
         return 1

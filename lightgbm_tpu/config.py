@@ -265,6 +265,26 @@ class Config:
     profile: bool = False
     profile_dir: str = "lightgbm_tpu_profile"
 
+    # ---- resilience (docs/resilience.md)
+    # checkpoint every N boosting iterations (0 = off); SIGTERM/SIGINT
+    # always checkpoint before exiting regardless
+    snapshot_freq: int = 0
+    # checkpoint directory; default "<output_model>.ckpt"
+    snapshot_dir: str = ""
+    # resume from the newest valid checkpoint (bare --resume on the CLI);
+    # the resumed run's final model is bitwise-identical to an
+    # uninterrupted run of the same config
+    resume: bool = False
+    # non-finite gradient/hessian/leaf-output guard:
+    # off (no checks) | raise (abort loudly) | skip_tree | clip
+    nonfinite_policy: str = "off"
+    # malformed rows / non-finite labels: false = counted+logged skip
+    # (telemetry bad_rows), true = raise at load time
+    strict_data: bool = False
+    # multihost collective deadline in seconds (0 = wait forever);
+    # LGBM_TPU_COLLECTIVE_DEADLINE_S overrides
+    collective_deadline_s: float = 0.0
+
     def __post_init__(self):
         if not self.metric:
             self.metric = []
@@ -368,6 +388,14 @@ class Config:
             raise ValueError("metric_freq must be >= 0")
         if not 0.0 <= self.drop_rate <= 1.0:
             raise ValueError("drop_rate must be in [0, 1]")
+        if self.nonfinite_policy not in ("off", "raise", "skip_tree", "clip"):
+            raise ValueError(
+                f"Unknown nonfinite_policy: {self.nonfinite_policy!r}"
+            )
+        if self.snapshot_freq < 0:
+            raise ValueError("snapshot_freq must be >= 0")
+        if self.collective_deadline_s < 0:
+            raise ValueError("collective_deadline_s must be >= 0")
         if not 0.0 <= self.skip_drop <= 1.0:
             raise ValueError("skip_drop must be in [0, 1]")
 

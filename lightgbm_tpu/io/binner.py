@@ -76,7 +76,17 @@ class BinMapper:
         m = BinMapper()
         m.bin_type = bin_type
         vals = np.asarray(sample_values, dtype=np.float64)
-        vals = vals[~np.isnan(vals)]
+        n_inf = int(np.isinf(vals).sum())
+        if n_inf:
+            # input hardening: an inf sample would put an inf midpoint
+            # into bin_upper_bound and poison every threshold after it.
+            # Treat inf like NaN (excluded from bin finding; at encode
+            # time it lands in the last/first bin via the clip), counted
+            # so a fleet dashboard sees the degradation
+            from ..obs import telemetry
+
+            telemetry.count("nonfinite_feature_values", n_inf)
+        vals = vals[np.isfinite(vals)]
         if total_sample_cnt is None:
             total_sample_cnt = len(vals)
         zero_cnt = int(total_sample_cnt - len(vals))

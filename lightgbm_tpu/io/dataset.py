@@ -24,11 +24,40 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import Config
+from ..log import Log
+from ..obs import telemetry
 from .binner import BinMapper, CATEGORICAL, NUMERICAL, find_bin_mappers
 from .metadata import Metadata
-from .parser import parse_file
+from .parser import ParseError, parse_file
 
 BINARY_MAGIC = "lightgbm_tpu_binned_dataset_v1"
+
+
+def _finite_label_mask(label_col: np.ndarray, config: Config, path: str,
+                       has_side_rows: bool = False) -> Optional[np.ndarray]:
+    """Input hardening: rows with non-finite labels are a counted,
+    logged skip (telemetry ``bad_rows``) — a single NaN label would
+    otherwise poison every gradient of the run.  Returns the keep mask,
+    or None when all labels are finite.  ``strict_data=true`` raises;
+    so does the presence of row-aligned side files (weights/query/
+    init_score), where silently renumbering rows would desynchronize
+    them."""
+    bad = ~np.isfinite(np.asarray(label_col, np.float64))
+    n_bad = int(bad.sum())
+    if n_bad == 0:
+        return None
+    msg = (f"{path}: {n_bad} row(s) with non-finite labels "
+           f"(first at data row {int(np.argmax(bad))})")
+    if config.strict_data:
+        raise ParseError(msg + " (strict_data=true)")
+    if has_side_rows:
+        raise ParseError(
+            msg + " — cannot skip rows: row-aligned side files "
+            "(.weight/.query/.init) would desynchronize. Clean the data "
+            "or regenerate the side files.")
+    telemetry.count("bad_rows", n_bad)
+    Log.warning(msg + "; skipping them (strict_data=false)")
+    return ~bad
 
 
 def _encode_bins(
@@ -450,17 +479,44 @@ class BinnedDataset:
             os.path.getsize(path) > (4 << 30)
         )
         if want_stream and single_machine and fmt != "libsvm":
-            return BinnedDataset._from_file_streaming(
-                path, config, fmt, reference=reference,
-                categorical_features=categorical_features,
-            )
-        raw, names = parse_file(path, has_header=config.has_header, fmt=fmt)
+            try:
+                return BinnedDataset._from_file_streaming(
+                    path, config, fmt, reference=reference,
+                    categorical_features=categorical_features,
+                )
+            except ParseError:
+                raise  # already classified (strict mode / label guard)
+            except ValueError as e:
+                # malformed rows mid-stream: the chunked fast reader
+                # cannot skip-and-continue (dropped rows would desync
+                # the counted preallocation), so degrade to the one-shot
+                # lenient path below — counted bad_rows skip semantics,
+                # at the cost of whole-file memory for an already-
+                # degraded input.  strict_data raises instead.
+                if config.strict_data:
+                    raise ParseError(
+                        f"{path}: malformed rows in streaming load "
+                        f"(strict_data=true): {type(e).__name__}: "
+                        f"{str(e)[:200]}") from e
+                Log.warning(
+                    f"{path}: streaming parse failed "
+                    f"({type(e).__name__}: {str(e)[:120]}); falling "
+                    "back to one-shot lenient load (malformed rows "
+                    "will be counted and skipped)")
+        raw, names = parse_file(path, has_header=config.has_header, fmt=fmt,
+                                strict=config.strict_data)
         side = Metadata.load_side_files(path)
 
         # ---- resolve column roles on the FULL file (dataset_loader.cpp:23-160)
         label_col, ignore, cats, weight_col, group_col = _resolve_roles(
             config, names
         )
+        keep = _finite_label_mask(
+            raw[:, label_col], config, path,
+            has_side_rows=any(side.get(k) is not None for k in
+                              ("weights", "query_boundaries", "init_score")))
+        if keep is not None:
+            raw = raw[keep]
         n = raw.shape[0]
         label = raw[:, label_col].astype(np.float32)
         weights = side.get("weights")
@@ -651,6 +707,16 @@ class BinnedDataset:
                 gid[offset:offset + m_rows] = chunk[:, group_col]
             offset += m_rows
 
+        keep = _finite_label_mask(
+            label, config, path,
+            has_side_rows=any(side.get(k) is not None for k in
+                              ("weights", "query_boundaries", "init_score")))
+        if keep is not None:
+            X_bin, label = X_bin[keep], label[keep]
+            weights = weights[keep] if weights is not None else None
+            gid = gid[keep] if gid is not None else None
+            n = int(keep.sum())
+
         qb = side.get("query_boundaries")
         if gid is not None:
             change = np.nonzero(np.diff(gid))[0] + 1
@@ -706,6 +772,17 @@ class BinnedDataset:
             path, has_header=config.has_header
         )
         side = Metadata.load_side_files(path)
+        keep = _finite_label_mask(
+            label, config, path,
+            has_side_rows=any(side.get(k) is not None for k in
+                              ("weights", "query_boundaries", "init_score")))
+        if keep is not None:
+            nz_keep = np.repeat(keep, np.diff(indptr))
+            indices, values = indices[nz_keep], values[nz_keep]
+            label = label[keep]
+            row_lens = np.diff(indptr)[keep]
+            indptr = np.concatenate([[0], np.cumsum(row_lens,
+                                                    dtype=np.int64)])
         n = len(label)
 
         ignore = set(_resolve_column_list(config.ignore_column, None))

@@ -13,6 +13,15 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..log import Log
+from ..obs import telemetry
+
+
+class ParseError(ValueError):
+    """Malformed input under strict_data=true.  The message names the
+    file and the first offending content so the operator can fix the
+    data instead of spelunking a pandas traceback."""
+
 
 def detect_format(sample_lines: List[str]) -> str:
     """Return one of 'csv', 'tsv', 'libsvm' (parser.cpp:72-144)."""
@@ -55,39 +64,87 @@ def parse_file(
     path: str,
     has_header: bool = False,
     fmt: Optional[str] = None,
+    strict: bool = False,
 ) -> Tuple[np.ndarray, Optional[List[str]]]:
     """Parse a data file into a dense float64 row-matrix.
 
     Returns (matrix including the label column if present, header names or
     None).  Column-role resolution (which column is the label etc.) is the
     caller's job, mirroring DatasetLoader (dataset_loader.cpp:23-160).
+
+    Malformed rows (unparseable tokens, wrong field counts) are a
+    counted, logged skip (telemetry counter ``bad_rows``) on the default
+    lenient path; ``strict=True`` (Config.strict_data) raises
+    :class:`ParseError` instead — never an unhandled exception from deep
+    inside pandas.
     """
     head = _read_head(path, 2 if not has_header else 3)
     if fmt is None:
         fmt = detect_format(head[1:] if has_header else head)
 
+    names = None
+    if has_header and head:
+        sep = "," if fmt == "csv" else None
+        names = [s.strip() for s in head[0].strip().split(sep)]
+
     # native fast path (src/native/lgbm_native.cpp; OpenMP row-parallel)
     from .. import native
 
-    mat = native.parse_file(path, fmt, skip_header=has_header)
+    try:
+        mat = native.parse_file(path, fmt, skip_header=has_header)
+    except Exception:
+        mat = None  # malformed input: fall through to the guarded paths
     if mat is not None:
-        names = None
-        if has_header and head:
-            sep = "," if fmt == "csv" else None
-            names = [s.strip() for s in head[0].strip().split(sep)]
-        return mat, names
+        return mat, names if has_header else None
 
     if fmt == "libsvm":
         with open(path, "r") as fh:
             if has_header:
                 fh.readline()
-            return _parse_libsvm(fh), None
+            return _parse_libsvm(fh, strict=strict, source=path), None
 
     import pandas as pd
 
-    df = pd.read_csv(path, **_read_csv_kwargs(head, fmt, has_header))
+    try:
+        df = pd.read_csv(path, **_read_csv_kwargs(head, fmt, has_header))
+    except (ValueError, pd.errors.ParserError) as e:
+        if strict:
+            raise ParseError(
+                f"{path}: malformed rows (strict_data=true): "
+                f"{type(e).__name__}: {str(e)[:200]}") from e
+        df = _lenient_read(path, head, fmt, has_header, pd)
     names = [str(c) for c in df.columns] if has_header else None
     return df.to_numpy(dtype=np.float64), names
+
+
+def _lenient_read(path: str, head: List[str], fmt: str, has_header: bool,
+                  pd):
+    """Degraded re-parse after the strict fast path failed: rows with
+    wrong field counts or unparseable tokens become a counted, logged
+    skip instead of an exception."""
+    kwargs = _read_csv_kwargs(head, fmt, has_header)
+    kwargs.pop("dtype")
+    bad = {"n": 0}
+
+    def on_bad(fields):  # wrong field count: drop the row, count it
+        bad["n"] += 1
+        return None
+
+    df = pd.read_csv(path, engine="python", on_bad_lines=on_bad, **{
+        k: v for k, v in kwargs.items() if k != "engine"})
+    num = df.apply(pd.to_numeric, errors="coerce")
+    # a cell that held a real (non-NA) token but failed numeric
+    # conversion marks its row malformed; NA tokens already became NaN
+    # in df and stay missing-value semantics, not errors
+    cell_bad = num.isna() & df.notna()
+    row_bad = cell_bad.any(axis=1)
+    bad["n"] += int(row_bad.sum())
+    if bad["n"]:
+        telemetry.count("bad_rows", bad["n"])
+        Log.warning(
+            f"{path}: skipped {bad['n']} malformed row(s) "
+            "(strict_data=false; set strict_data=true to raise instead)")
+    return num[~row_bad].astype(np.float64)
 
 
 def _read_csv_kwargs(head: List[str], fmt: str, has_header: bool) -> dict:
@@ -112,28 +169,46 @@ def _read_csv_kwargs(head: List[str], fmt: str, has_header: bool) -> dict:
     )
 
 
-def _parse_libsvm(lines) -> np.ndarray:
+def _parse_libsvm(lines, strict: bool = False,
+                  source: str = "<lines>") -> np.ndarray:
     """LibSVM ``label idx:val ...`` lines -> dense matrix (column 0 = label).
 
-    ``lines`` is any iterable of strings (an open file, a list, ...)."""
+    ``lines`` is any iterable of strings (an open file, a list, ...).
+    Malformed lines: counted, logged skip (``bad_rows``), or
+    :class:`ParseError` under ``strict``."""
     labels: List[float] = []
     rows: List[Tuple[np.ndarray, np.ndarray]] = []
     max_idx = -1
-    for line in lines:
+    n_bad = 0
+    for lineno, line in enumerate(lines, start=1):
         parts = line.split()
         if not parts:
             continue
-        labels.append(float(parts[0]))
-        if len(parts) > 1:
-            kv = np.array([p.split(":") for p in parts[1:]])
-            idx = kv[:, 0].astype(np.int64)
-            val = kv[:, 1].astype(np.float64)
-        else:
-            idx = np.empty(0, dtype=np.int64)
-            val = np.empty(0, dtype=np.float64)
+        try:
+            label = float(parts[0])
+            if len(parts) > 1:
+                kv = np.array([p.split(":") for p in parts[1:]])
+                idx = kv[:, 0].astype(np.int64)
+                val = kv[:, 1].astype(np.float64)
+            else:
+                idx = np.empty(0, dtype=np.int64)
+                val = np.empty(0, dtype=np.float64)
+        except (ValueError, IndexError) as e:
+            if strict:
+                raise ParseError(
+                    f"{source}: malformed libsvm line {lineno} "
+                    f"({line.strip()[:80]!r}) (strict_data=true)") from e
+            n_bad += 1
+            continue
+        labels.append(label)
         if len(idx):
             max_idx = max(max_idx, int(idx.max()))
         rows.append((idx, val))
+    if n_bad:
+        telemetry.count("bad_rows", n_bad)
+        Log.warning(
+            f"{source}: skipped {n_bad} malformed libsvm line(s) "
+            "(strict_data=false; set strict_data=true to raise instead)")
     n, f = len(labels), max_idx + 1
     out = np.zeros((n, f + 1), dtype=np.float64)
     out[:, 0] = labels
@@ -198,11 +273,13 @@ def parse_file_chunks(
 
 
 def parse_lines(lines: List[str], fmt: Optional[str] = None) -> np.ndarray:
-    """Parse in-memory text lines (used by the Predictor file path)."""
+    """Parse in-memory text lines (used by the Predictor file path).
+    Strict: prediction outputs are joined to inputs by row number, so a
+    skipped malformed line would misattribute every later prediction."""
     if fmt is None:
         fmt = detect_format(lines[:2])
     if fmt == "libsvm":
-        return _parse_libsvm(lines)
+        return _parse_libsvm(lines, strict=True)
     import pandas as pd
 
     buf = io.StringIO("".join(l if l.endswith("\n") else l + "\n" for l in lines))

@@ -28,6 +28,8 @@ from ..compat import enable_x64
 from ..config import Config
 from ..io.dataset import BinnedDataset
 from ..obs import telemetry
+from ..resilience import faults
+from ..resilience.atomic import atomic_write
 from ..obs.device_time import phase_scope
 from ..learners.serial import TreeLearnerParams, grow_tree
 from ..metrics import Metric, create_metrics
@@ -117,6 +119,14 @@ class GBDT:
         # enabled per-trace via the jax.enable_x64 context in
         # train_one_iter, never by flipping the process-global flag.
         self._use_f64_hist = config.hist_dtype == "float64"
+        # non-finite gradient/leaf guard (resilience/guards.py); None
+        # under the default policy "off" — zero cost, zero behavior drift
+        if getattr(config, "nonfinite_policy", "off") != "off":
+            from ..resilience.guards import make_guard
+
+            self._nf_guard = make_guard(config.nonfinite_policy)
+        else:
+            self._nf_guard = None
         self._model_version = 0
         if train_set is not None:
             self.reset_training_data(train_set, objective)
@@ -516,6 +526,22 @@ class GBDT:
             grad = jnp.asarray(grad, jnp.float32).reshape(K, self.num_data)
             hess = jnp.asarray(hess, jnp.float32).reshape(K, self.num_data)
 
+        # chaos hook (LGBM_TPU_FAULT=nan_grads:J): deterministic gradient
+        # poisoning, so the guard below is exercised by tests, not trusted
+        grad, hess = faults.poison_grads(grad, hess, self.iter_)
+        nf_snap = None
+        if self._nf_guard is not None:
+            if self._nf_guard.policy == "raise":
+                # pre-iteration snapshot: the only rollback that works
+                # once NaN reaches the score buffers is an exact restore
+                # (see NonFiniteGuard.raise_if_poisoned).  One async
+                # device copy of the score buffers per iteration — the
+                # opt-in policy's cost, never the default path's.
+                nf_snap = self.snapshot_state()
+            grad, hess, skip_iter = self._nf_guard.check_gradients(grad, hess)
+            if skip_iter:
+                return False
+
         self._update_bagging()
         could_split_any = False
         for k in range(K):
@@ -564,6 +590,10 @@ class GBDT:
                     pass
                 self._pending_stop.append(nl)
                 could_split_any = True
+            if self._nf_guard is not None:
+                # leaf-output guard (clip/count); never drops a tree —
+                # the models list must stay iter-major K-aligned
+                tree, _ = self._nf_guard.check_tree(tree)
             # shrink + score apply + threshold finalization as ONE
             # dispatch (each eager jnp op is its own round trip over the
             # axon tunnel; the host-side finalize_thresholds even forced
@@ -580,6 +610,10 @@ class GBDT:
             self.models.append(tree)
         self.iter_ += 1
         self._model_version += 1
+        if self._nf_guard is not None:
+            # policy=raise drains its parked device counts here — the
+            # iteration's end, where the eager stop check already synced
+            self._nf_guard.raise_if_poisoned(self, nf_snap)
         return not could_split_any
 
     def finish_lagged_stop(self) -> None:
@@ -597,6 +631,15 @@ class GBDT:
                     self.rollback_one_iter()
                 self._pending_stop.clear()
                 break
+
+    def finalize_guards(self) -> None:
+        """End-of-training drain of the non-finite guard's lazily
+        accumulated counts (policy=clip batches device fetches; without
+        this drain a short run would report zero clipped values and the
+        degradation would be invisible).  Under policy=raise a pending
+        poisoned final iteration surfaces here as NonFiniteError."""
+        if self._nf_guard is not None:
+            self._nf_guard.finalize()
 
     def snapshot_state(self) -> tuple:
         """Capture every per-iteration mutable of the training state
@@ -917,8 +960,12 @@ class GBDT:
         return "\n".join(out) + "\n"
 
     def save_model_to_file(self, filename: str, num_iteration: int = -1) -> None:
-        with open(filename, "w") as fh:
-            fh.write(self.save_model_to_string(num_iteration))
+        # atomic + checksummed: a preemption mid-save must never leave a
+        # truncated model (which would silently LOAD, with fewer trees)
+        # under the real name; the .sha256 sidecar makes "is this model
+        # intact?" checkable (resilience/atomic.py)
+        atomic_write(filename, self.save_model_to_string(num_iteration),
+                     checksum=True)
 
     def load_model_from_string(self, model_str: str) -> None:
         """gbdt.cpp:523-592."""

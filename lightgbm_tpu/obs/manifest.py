@@ -182,14 +182,13 @@ class RunManifest:
         return cls(**{k: v for k, v in d.items() if k in known})
 
     def write(self, path: str) -> str:
+        # shared crash-safe writer (resilience/atomic.py): tmp + fsync +
+        # rename — a crash mid-write must not leave a half manifest
+        # shadowing a real result artifact
+        from ..resilience.atomic import atomic_write_json
+
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self.to_dict(), fh, indent=1, sort_keys=True)
-            fh.write("\n")
-        os.replace(tmp, path)  # atomic: a crash mid-write must not leave
-        # a half manifest shadowing a real result artifact
-        return path
+        return atomic_write_json(path, self.to_dict())
 
     @classmethod
     def load(cls, path: str) -> "RunManifest":

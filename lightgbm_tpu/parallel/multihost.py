@@ -186,7 +186,17 @@ def sync_config_across_processes(cfg) -> None:
         [float(getattr(cfg, k, 1.0)) for k in frac_names], np.float64
     )
     payload = np.concatenate([seeds, fracs.view(np.int32)])  # [3 + 4] i32
-    gathered = multihost_utils.process_allgather(payload)  # [P, 7] i32
+    # guarded collective (resilience/retry.py): a peer that died before
+    # joining this allgather would otherwise hang EVERY rank forever.
+    # collective_deadline_s (or LGBM_TPU_COLLECTIVE_DEADLINE_S) bounds
+    # the wait and fails loudly; transient UNAVAILABLE errors retry with
+    # backoff (and the fail_collective_once chaos fault injects here).
+    from ..resilience.retry import collective_deadline_s, guarded_collective
+
+    gathered = guarded_collective(
+        lambda: multihost_utils.process_allgather(payload),
+        deadline_s=collective_deadline_s(cfg),
+        label="config sync allgather")  # [P, 7] i32
     gathered = np.ascontiguousarray(np.asarray(gathered))
     seed_min = gathered[:, :3].min(axis=0)
     frac_all = gathered[:, 3:].view(np.float64)  # [P, 2]

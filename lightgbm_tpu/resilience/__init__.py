@@ -1,0 +1,54 @@
+"""Fault tolerance for preemptible fleets.
+
+Production TPU fleets preempt, OOM, and drop hosts; the reference CLI's
+answer is "rerun the job".  This subsystem makes a training run
+survivable instead:
+
+* :mod:`~lightgbm_tpu.resilience.atomic` — crash-safe artifact writes
+  (tmp file + fsync + rename, optional sha256 sidecar).  A SIGKILL
+  mid-write must never leave half a model/manifest/bench JSON shadowing
+  a real artifact.
+* :mod:`~lightgbm_tpu.resilience.checkpoint` — exact training-state
+  checkpoints + resume such that the resumed final model is BITWISE
+  identical to an uninterrupted run (tier-1 contract,
+  tests/test_resilience.py).
+* :mod:`~lightgbm_tpu.resilience.guards` — non-finite gradient/leaf
+  guards with ``raise | skip_tree | clip`` policies, checked at the
+  library's existing deliberate sync points (never a new hot-path sync).
+* :mod:`~lightgbm_tpu.resilience.retry` — bounded retry-with-backoff
+  for transient device/collective failures and a collective deadline
+  that fails loudly instead of hanging a preempted world.
+* :mod:`~lightgbm_tpu.resilience.faults` — deterministic fault
+  injection (``LGBM_TPU_FAULT``) so every recovery path above is
+  exercised by tests (tools/chaos.py) rather than trusted.
+
+This module and ``atomic``/``faults``/``retry`` import neither jax nor
+numpy: tools (benchdiff, jaxlint) adopt atomic writes without paying a
+jax import.  ``checkpoint``/``guards`` are imported lazily by their
+users (cli.py, models/gbdt.py).
+"""
+
+from .atomic import (  # noqa: F401
+    ArtifactCorrupt,
+    atomic_write,
+    atomic_write_json,
+    atomic_writer,
+    sidecar_path,
+    verify_sidecar,
+)
+from .faults import (  # noqa: F401
+    InjectedFault,
+    clear_faults,
+    fault_active,
+    set_fault,
+)
+from .retry import (  # noqa: F401
+    CollectiveDeadlineExceeded,
+    call_with_deadline,
+    retry_transient,
+)
+
+EXIT_PREEMPTED = 75
+"""CLI exit status for "training was preempted but checkpointed": the
+sysexits EX_TEMPFAIL convention — a supervisor should re-launch with
+``resume=true``.  Distinct from 0 (done) and 1 (error)."""

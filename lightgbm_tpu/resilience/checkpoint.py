@@ -1,0 +1,465 @@
+"""Training checkpoints + resume with a BITWISE-identity contract.
+
+A checkpoint captures every per-iteration mutable of a training run —
+the grown trees (exact device arrays, not the text round-trip), the
+float32 score buffers byte-for-byte, bagging/feature/drop RNG states,
+the bagging mask, early-stopping bests, lagged-stop parked values —
+so that ``kill at iteration k; resume`` produces a final model file
+bitwise-identical to the uninterrupted run (tier-1 contract,
+tests/test_resilience.py; chaos proof, tools/chaos.py).
+
+Why exact arrays and not the model string: ``threshold_real`` is the
+float32 cast of a float64 bin bound, and recovering the bin from the
+cast (models/gbdt.py ``_rebind_tree``) tolerates text-format noise with
+an epsilon SMALLER than a float32 ulp — correct for interop, not
+guaranteed exact.  The model string still rides along (``model_str``)
+as human-readable lineage and an interop escape hatch.
+
+Format: one JSON file per checkpoint (``ckpt_00000010.json`` in
+``<output_model>.ckpt/`` by default), arrays as zlib+base64 blobs, a
+``sha256`` header over the canonical payload serialization, and a
+lineage block (git sha, config fingerprint, previous checkpoint's
+digest).  Writes go through :func:`~.atomic.atomic_write` — a
+preemption mid-checkpoint leaves the previous checkpoint intact, never
+half a file.  Resume validates checksum and config fingerprint and
+refuses LOUDLY on mismatch: silently restarting over corruption is the
+failure mode this module exists to kill.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import glob
+import hashlib
+import json
+import os
+import signal
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..log import Log
+from ..obs import telemetry
+from ..obs.manifest import _git_info, config_fingerprint
+from . import EXIT_PREEMPTED
+from . import faults
+from .atomic import atomic_write
+
+SCHEMA = "lightgbm-tpu/checkpoint/v1"
+_KEEP = 2  # checkpoints retained per run (newest + one fallback)
+
+
+class CheckpointError(Exception):
+    """A checkpoint could not be used.  Messages are actionable — they
+    name the file, the mismatch, and the operator's options."""
+
+
+class TrainingPreempted(Exception):
+    """Raised out of the train loop after a SIGTERM/SIGINT-triggered
+    checkpoint; cli.main converts it to :data:`EXIT_PREEMPTED`."""
+
+    exit_code = EXIT_PREEMPTED
+
+    def __init__(self, path: str, iteration: int) -> None:
+        super().__init__(
+            f"training preempted at iteration {iteration}; checkpoint "
+            f"saved to {path} — re-run with resume=true to continue")
+        self.path = path
+        self.iteration = iteration
+
+
+# ------------------------------------------------------------- array codec
+def _enc(arr) -> dict:
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {
+        "dtype": a.dtype.str,
+        "shape": list(a.shape),
+        "z64": base64.b64encode(zlib.compress(a.tobytes(), 1)).decode(),
+    }
+
+
+def _dec(d: dict) -> np.ndarray:
+    raw = zlib.decompress(base64.b64decode(d["z64"]))
+    return np.frombuffer(raw, dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+
+
+def _enc_rng(rng: np.random.RandomState) -> dict:
+    alg, keys, pos, has_gauss, cached = rng.get_state()
+    return {"alg": alg, "keys": _enc(keys), "pos": int(pos),
+            "has_gauss": int(has_gauss), "cached_gaussian": float(cached)}
+
+
+def _dec_rng(d: dict) -> tuple:
+    return (d["alg"], _dec(d["keys"]), d["pos"], d["has_gauss"],
+            d["cached_gaussian"])
+
+
+# ---------------------------------------------------------- fingerprinting
+def training_fingerprint(cfg) -> Optional[str]:
+    """Config fingerprint for checkpoint compatibility: the full config
+    minus the resume switch itself (a resumed run flips ``resume`` and
+    nothing else; everything else — data, trees, seeds, snapshot cadence
+    — must match for the bitwise contract to hold)."""
+    if cfg is None:
+        return None
+    d = dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg) else dict(vars(cfg))
+    d.pop("resume", None)
+    return config_fingerprint(d)
+
+
+# ------------------------------------------------------------ state capture
+def _capture_models(booster) -> List[dict]:
+    """Stacked tree arrays, grouped by padding shape (one group per run
+    of consecutive same-shape trees; normally exactly one group, more
+    when an ``input_model`` with a different num_leaves was merged).
+    Exact: no re-binning, no text round trip."""
+    groups: List[dict] = []
+    run: List = []
+    run_shape = None
+    from ..models.tree import Tree
+
+    def flush():
+        if run:
+            groups.append({
+                "count": len(run),
+                "fields": {
+                    name: _enc(np.stack([np.asarray(getattr(t, name))
+                                         for t in run]))
+                    for name in Tree._fields
+                },
+            })
+
+    for t in booster.models:
+        shape = t.leaf_value.shape
+        if shape != run_shape and run:
+            flush()
+            run = []
+        run_shape = shape
+        run.append(t)
+    flush()
+    return groups
+
+
+def _restore_models(groups: List[dict]) -> List:
+    import jax.numpy as jnp
+
+    from ..models.tree import Tree
+
+    models: List = []
+    for g in groups:
+        fields = {name: _dec(d) for name, d in g["fields"].items()}
+        for i in range(g["count"]):
+            models.append(Tree(**{
+                name: jnp.asarray(arr[i]) for name, arr in fields.items()
+            }))
+    return models
+
+
+def save_checkpoint(path: str, booster, cfg, *, iteration: int,
+                    best_score: Optional[Dict[tuple, float]] = None,
+                    best_iter: Optional[Dict[tuple, int]] = None,
+                    prev_sha: Optional[str] = None) -> str:
+    """Serialize the full training state after ``iteration`` completed
+    boosting iterations.  Reading the device buffers is a deliberate
+    host sync (counted); the checkpoint cadence, not the tree loop,
+    pays it."""
+    telemetry.host_sync()
+    payload: Dict = {
+        "schema": SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "iteration": int(iteration),
+        "config_fingerprint": training_fingerprint(cfg),
+        "lineage": {
+            "git": _git_info(),
+            "entry": "cli.train",
+            "data": getattr(cfg, "data", None),
+            "output_model": getattr(cfg, "output_model", None),
+            "prev_checkpoint_sha256": prev_sha,
+        },
+        "booster": {
+            "name": booster.name,
+            "iter_": int(booster.iter_),
+            "num_init_iteration": int(booster.num_init_iteration),
+            "num_class": int(booster.num_class),
+            "objective": booster.objective_name(),
+            "pending_stop": [int(v) for v in booster._pending_stop],
+        },
+        "models": _capture_models(booster),
+        "model_str": base64.b64encode(zlib.compress(
+            booster.save_model_to_string(-1).encode(), 1)).decode(),
+        "scores": _enc(booster._scores),
+        "valid_scores": [_enc(v) for v in
+                         getattr(booster, "_valid_scores", [])],
+        "bagging": {
+            "mask_bits": _enc(np.packbits(
+                np.asarray(booster._bag_mask) != 0)),
+            "n": int(np.asarray(booster._bag_mask).shape[0]),
+            "cnt": int(booster._bag_cnt),
+        },
+        "rng": {
+            "bag": _enc_rng(booster._bag_rng),
+            "feat": _enc_rng(booster._feat_rng),
+        },
+        "early_stop": {
+            "best": [
+                [int(di), str(name), float((best_score or {})[(di, name)]),
+                 int((best_iter or {})[(di, name)])]
+                for (di, name) in (best_score or {})
+            ],
+        },
+        "telemetry": telemetry.get_telemetry().snapshot(),
+    }
+    if hasattr(booster, "_drop_rng"):  # DART extras
+        payload["dart"] = {
+            "drop_rng": _enc_rng(booster._drop_rng),
+            "tree_weight": [float(w) for w in booster.tree_weight],
+            "sum_weight": float(booster.sum_weight),
+        }
+    if hasattr(booster, "_nf_guard") and booster._nf_guard is not None:
+        payload["nonfinite"] = booster._nf_guard.state_dict()
+
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    doc = {"schema": SCHEMA,
+           "sha256": hashlib.sha256(blob.encode()).hexdigest(),
+           "payload": payload}
+    atomic_write(path, json.dumps(doc, sort_keys=True,
+                                  separators=(",", ":")) + "\n")
+    telemetry.count("checkpoints_written")
+    return path
+
+
+def load_checkpoint(path: str) -> dict:
+    """Parse + validate one checkpoint file.  Raises
+    :class:`CheckpointError` (loud, actionable) on any corruption."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: "
+            f"{str(e)[:120]}) — it was truncated or corrupted. Delete it "
+            "to resume from the previous checkpoint, or restart without "
+            "resume=true to train from scratch.") from e
+    payload = doc.get("payload")
+    if doc.get("schema") != SCHEMA or not isinstance(payload, dict):
+        raise CheckpointError(
+            f"checkpoint {path} has schema {doc.get('schema')!r}, "
+            f"expected {SCHEMA!r} — it was written by an incompatible "
+            "version; restart without resume=true.")
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    got = hashlib.sha256(blob.encode()).hexdigest()
+    if got != doc.get("sha256"):
+        raise CheckpointError(
+            f"checkpoint {path} FAILED its content checksum "
+            f"(sha256 {got[:16]}… != recorded "
+            f"{str(doc.get('sha256'))[:16]}…) — the file was corrupted "
+            "after writing. Delete it to fall back to the previous "
+            "checkpoint, or restart without resume=true.")
+    return payload
+
+
+def validate_against_config(payload: dict, cfg, path: str = "") -> None:
+    want = training_fingerprint(cfg)
+    have = payload.get("config_fingerprint")
+    if want != have:
+        raise CheckpointError(
+            f"checkpoint {path or '<payload>'} was written under config "
+            f"fingerprint {have}, but this run's is {want} — resuming "
+            "under a different configuration would NOT reproduce the "
+            "uninterrupted run. Re-run with the original parameters "
+            "(only the resume flag may differ), or restart without "
+            "resume=true.")
+
+
+def restore_training_state(booster, payload: dict,
+                           best_score: Optional[Dict] = None,
+                           best_iter: Optional[Dict] = None) -> int:
+    """Install a checkpoint payload into a freshly-constructed booster
+    (data already loaded, valid sets already attached).  Mirrors
+    ``GBDT.restore_state`` field-for-field, from host bytes.  Returns
+    the number of completed boosting iterations."""
+    import jax.numpy as jnp
+
+    b = payload["booster"]
+    if b["num_class"] != booster.num_class:
+        raise CheckpointError(
+            f"checkpoint num_class={b['num_class']} != configured "
+            f"{booster.num_class}")
+    booster.models = _restore_models(payload["models"])
+    booster.iter_ = int(b["iter_"])
+    booster.num_init_iteration = int(b["num_init_iteration"])
+    booster._pending_stop = [int(v) for v in b.get("pending_stop", [])]
+    booster._scores = jnp.asarray(_dec(payload["scores"]))
+    valid = [jnp.asarray(_dec(v)) for v in payload.get("valid_scores", [])]
+    if valid:
+        if len(getattr(booster, "_valid_scores", [])) != len(valid):
+            raise CheckpointError(
+                f"checkpoint carries {len(valid)} valid-set score "
+                f"buffers, run has "
+                f"{len(getattr(booster, '_valid_scores', []))} — the "
+                "valid_data list must match the original run's")
+        for i, v in enumerate(valid):
+            booster._valid_scores[i] = v
+    bag = payload["bagging"]
+    mask = np.unpackbits(_dec(bag["mask_bits"]))[: bag["n"]]
+    booster._bag_mask = jnp.asarray(mask.astype(np.float32))
+    booster._bag_cnt = int(bag["cnt"])
+    booster._bag_rng.set_state(_dec_rng(payload["rng"]["bag"]))
+    booster._feat_rng.set_state(_dec_rng(payload["rng"]["feat"]))
+    if "dart" in payload and hasattr(booster, "_drop_rng"):
+        booster._drop_rng.set_state(_dec_rng(payload["dart"]["drop_rng"]))
+        booster.tree_weight = list(payload["dart"]["tree_weight"])
+        booster.sum_weight = float(payload["dart"]["sum_weight"])
+    if "nonfinite" in payload and getattr(booster, "_nf_guard", None):
+        booster._nf_guard.load_state_dict(payload["nonfinite"])
+    if best_score is not None:
+        for di, name, score, it in payload["early_stop"]["best"]:
+            best_score[(int(di), name)] = float(score)
+            if best_iter is not None:
+                best_iter[(int(di), name)] = int(it)
+    booster._model_version += 1
+    telemetry.count("checkpoints_resumed")
+    return int(payload["iteration"])
+
+
+# ----------------------------------------------------------- dir handling
+def checkpoint_dir(cfg) -> str:
+    d = getattr(cfg, "snapshot_dir", "") or ""
+    return d or (getattr(cfg, "output_model", "model.txt") + ".ckpt")
+
+
+def checkpoint_file(directory: str, iteration: int) -> str:
+    return os.path.join(directory, f"ckpt_{iteration:08d}.json")
+
+
+def list_checkpoints(directory: str) -> List[str]:
+    """Checkpoint paths, oldest first (iteration-numbered names sort)."""
+    return sorted(glob.glob(os.path.join(directory, "ckpt_*.json")))
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    cks = list_checkpoints(directory)
+    return cks[-1] if cks else None
+
+
+def load_latest_for(cfg) -> Optional[Tuple[str, dict]]:
+    """Resolve + validate the newest checkpoint for this run.  Returns
+    ``(path, payload)``, or None when the run has no checkpoints at all
+    (a preemption before the first snapshot: resuming from scratch IS
+    the lossless continuation).  Corruption or a config mismatch raises
+    — never silently restarts."""
+    path = latest_checkpoint(checkpoint_dir(cfg))
+    if path is None:
+        return None
+    payload = load_checkpoint(path)
+    validate_against_config(payload, cfg, path)
+    return path, payload
+
+
+def prune_checkpoints(directory: str, keep: int = _KEEP) -> None:
+    for stale in list_checkpoints(directory)[:-keep]:
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+
+
+# -------------------------------------------------------- train-loop hook
+class CheckpointManager:
+    """The cli train loop's preemption guard: periodic snapshots
+    (``snapshot_freq``), SIGTERM/SIGINT capture that lets the in-flight
+    iteration finish, and the checkpoint-then-exit handshake.
+
+    Use as a context manager around the train loop; handlers are
+    restored on exit.  ``after_iteration(it)`` is the single hook the
+    loop calls — it injects the ``kill_after_tree`` chaos fault, writes
+    due snapshots, and raises :class:`TrainingPreempted` after a
+    stop-signal checkpoint."""
+
+    def __init__(self, cfg, booster, best_score: Dict, best_iter: Dict):
+        self.cfg = cfg
+        self.booster = booster
+        self.best_score = best_score
+        self.best_iter = best_iter
+        self.freq = int(getattr(cfg, "snapshot_freq", 0) or 0)
+        self.dir = checkpoint_dir(cfg)
+        self.enabled = self.freq > 0
+        self._stop_signum: Optional[int] = None
+        self._old_handlers: Dict[int, object] = {}
+        self._last_sha: Optional[str] = None
+
+    # -- signals
+    def _on_signal(self, signum, frame) -> None:
+        # handler body is minimal and re-entrant: set the flag; the
+        # train loop checkpoints at the next iteration boundary (the
+        # in-flight tree finishes — a half-grown tree is not a state
+        # anyone can resume from)
+        if self._stop_signum is not None:
+            # SECOND signal: the operator means it (a long compile or a
+            # minutes-long iteration is in flight) — restore the default
+            # disposition and re-raise, aborting immediately without a
+            # checkpoint.  Ctrl-C twice must never require SIGKILL.
+            Log.warning(
+                f"second {signal.Signals(signum).name}: aborting "
+                "immediately (no checkpoint)")
+            signal.signal(signum,
+                          self._old_handlers.get(signum, signal.SIG_DFL))
+            os.kill(os.getpid(), signum)
+            return
+        self._stop_signum = signum
+        Log.warning(
+            f"received {signal.Signals(signum).name}; finishing the "
+            "in-flight iteration, then checkpointing and exiting "
+            f"(exit status {EXIT_PREEMPTED}); send again to abort "
+            "immediately")
+
+    def __enter__(self) -> "CheckpointManager":
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+        except ValueError:
+            # not the main thread (embedded use): periodic snapshots
+            # still work, signal capture does not
+            self._old_handlers = {}
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, old in self._old_handlers.items():
+            signal.signal(sig, old)
+
+    # -- the loop hook
+    def after_iteration(self, it: int) -> None:
+        completed = it + 1
+        faults.maybe_kill(completed)  # chaos: may deliver SIGTERM here
+        if self._stop_signum is not None:
+            path = self.write(completed)
+            raise TrainingPreempted(path or "<snapshots disabled>",
+                                    completed)
+        if self.enabled and completed % self.freq == 0:
+            self.write(completed)
+
+    def write(self, completed: int) -> Optional[str]:
+        if not self.enabled and self._stop_signum is None:
+            return None
+        os.makedirs(self.dir, exist_ok=True)
+        path = checkpoint_file(self.dir, completed)
+        save_checkpoint(path, self.booster, self.cfg,
+                        iteration=completed, best_score=self.best_score,
+                        best_iter=self.best_iter, prev_sha=self._last_sha)
+        if faults.maybe_corrupt_checkpoint(path):
+            Log.warning(f"FAULT corrupt_checkpoint: corrupted {path}")
+        self._last_sha = _file_payload_sha(path)
+        prune_checkpoints(self.dir)
+        Log.info(f"Checkpoint written: {path} (iteration {completed})")
+        return path
+
+
+def _file_payload_sha(path: str) -> Optional[str]:
+    try:
+        with open(path) as fh:
+            return json.load(fh).get("sha256")
+    except Exception:
+        return None

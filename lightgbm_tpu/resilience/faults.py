@@ -1,0 +1,168 @@
+"""Deterministic fault injection: every recovery path gets a test.
+
+``LGBM_TPU_FAULT`` holds a comma-separated list of fault specs; each
+spec is ``kind`` or ``kind:param``.  The injection points live INSIDE
+the production code paths they exercise, so a chaos run drives exactly
+the code a real preemption would:
+
+==========================  ====================================================
+spec                        injection point
+==========================  ====================================================
+``kill_after_tree:K``       cli train loop raises SIGTERM to the process the
+                            moment iteration K completes — the real
+                            preemption signal through the real handler
+``corrupt_checkpoint``      every checkpoint write is followed by flipping
+                            bytes mid-file — resume must refuse it loudly
+``nan_grads:J``             gradient poisoning at boosting iteration J
+                            (models/gbdt.py) — exercises the non-finite
+                            guard policies
+``fail_collective_once``    first guarded collective raises a fake
+                            ``UNAVAILABLE`` — exercises retry_transient
+``fail_write_once``         first atomic_write fails before its rename —
+                            the destination must stay intact
+==========================  ====================================================
+
+The env var is read once at import (the repo-wide convention for
+behavior knobs); tests inject in-process via :func:`set_fault` /
+:func:`clear_faults`.  ``*_once`` faults self-consume.  No jax/numpy
+imports — the gradient poisoner operates on whatever array type it is
+handed via duck-typed ops.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Dict, Optional
+
+_VALID = ("kill_after_tree", "corrupt_checkpoint", "nan_grads",
+          "fail_collective_once", "fail_write_once")
+
+
+class InjectedFault(Exception):
+    """Base for all injected failures — distinguishable from real ones
+    in test assertions, indistinguishable in the recovery paths (which
+    must not special-case it)."""
+
+
+class InjectedWriteError(InjectedFault, OSError):
+    pass
+
+
+class InjectedCollectiveError(InjectedFault, RuntimeError):
+    pass
+
+
+def _parse(spec: str) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, param = part.partition(":")
+        if kind not in _VALID:
+            raise ValueError(
+                f"unknown LGBM_TPU_FAULT kind {kind!r} "
+                f"(valid: {', '.join(_VALID)})")
+        out[kind] = param or None
+    return out
+
+
+_FAULTS: Dict[str, Optional[str]] = _parse(os.environ.get("LGBM_TPU_FAULT", ""))
+_CONSUMED: set = set()
+
+
+def set_fault(spec: str) -> None:
+    """Replace the active fault set in-process (tests/chaos dryrun)."""
+    global _FAULTS
+    _FAULTS = _parse(spec)
+    _CONSUMED.clear()
+
+
+def clear_faults() -> None:
+    set_fault("")
+
+
+def fault_active(kind: str) -> Optional[str]:
+    """The fault's param ("" when parameterless) or None when inactive
+    (or already consumed, for ``*_once`` kinds)."""
+    if kind not in _FAULTS or kind in _CONSUMED:
+        return None
+    return _FAULTS[kind] or ""
+
+
+def _consume(kind: str) -> None:
+    _CONSUMED.add(kind)
+
+
+# ------------------------------------------------------- injection points
+def kill_after_tree() -> Optional[int]:
+    """Iteration count after which the training loop should receive
+    SIGTERM, or None."""
+    p = fault_active("kill_after_tree")
+    return int(p) if p else None
+
+
+def maybe_kill(completed_iterations: int) -> None:
+    """cli train-loop hook: raise the REAL preemption signal to this
+    process once iteration K has completed (the handler then finishes
+    bookkeeping and checkpoints, exactly as under a fleet preemption)."""
+    k = kill_after_tree()
+    if k is not None and completed_iterations == k:
+        _consume("kill_after_tree")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_fail_write(path: str) -> None:
+    """atomic_write hook, fired after the tmp file is written but BEFORE
+    the rename: the crash window the atomic protocol exists to survive."""
+    if fault_active("fail_write_once") is not None:
+        _consume("fail_write_once")
+        raise InjectedWriteError(
+            f"injected write failure before committing {path}")
+
+
+def maybe_fail_collective() -> None:
+    """Guarded-collective hook: one fake transient failure, in the
+    vocabulary real collective stacks use (retry_transient keys on it)."""
+    if fault_active("fail_collective_once") is not None:
+        _consume("fail_collective_once")
+        raise InjectedCollectiveError(
+            "UNAVAILABLE: injected transient collective failure")
+
+
+def maybe_corrupt_checkpoint(path: str) -> bool:
+    """Checkpoint-writer hook: overwrite bytes in the middle of the
+    freshly committed file with ASCII filler.  ASCII (not bit-flips) so
+    the JSON usually stays *parseable* and the corruption is caught by
+    the content CHECKSUM — the deepest validation layer; when the filler
+    happens to break the JSON structure instead, the shallower
+    unreadable-file error path is exercised.  Either way the resume must
+    refuse loudly.  Returns True when corruption was injected."""
+    if fault_active("corrupt_checkpoint") is None:
+        return False
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        fh.write(b"A" * min(16, max(1, size // 2)))
+    return True
+
+
+def poison_grads(grad, hess, iteration: int):
+    """models/gbdt.py hook: at boosting iteration J, overwrite the first
+    gradient lane of every class with NaN (and one hessian lane with
+    +inf, so both operands are exercised).  Duck-typed: works on jax and
+    numpy arrays alike."""
+    p = fault_active("nan_grads")
+    if p is None or iteration != int(p or 0):
+        return grad, hess
+    _consume("nan_grads")
+    grad = grad.at[..., 0].set(float("nan")) if hasattr(grad, "at") else _np_poison(grad, float("nan"))
+    hess = hess.at[..., 0].set(float("inf")) if hasattr(hess, "at") else _np_poison(hess, float("inf"))
+    return grad, hess
+
+
+def _np_poison(arr, value):
+    arr = arr.copy()
+    arr[..., 0] = value
+    return arr

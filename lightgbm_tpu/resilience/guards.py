@@ -1,0 +1,220 @@
+"""Non-finite guards: graceful degradation instead of silent garbage.
+
+A single NaN gradient (a poisoned row, an overflowing custom objective,
+a bad init score) propagates through histogram sums into every split
+gain and leaf value of the tree — and float32 training will neither
+crash nor warn.  The guard watches the two places non-finites enter the
+model (gradients/hessians before growing, leaf outputs after) under a
+configurable policy (``Config.nonfinite_policy``):
+
+* ``off`` (default) — zero checks, zero cost: the exact pre-existing
+  behavior.
+* ``raise`` — count non-finites on device (one tiny fused reduction per
+  iteration, async), materialize the count at the iteration's existing
+  deliberate sync point, and abort loudly (after rolling the poisoned
+  iteration back) via :class:`NonFiniteError`.
+* ``skip_tree`` — materialize the gradient check BEFORE growing (this
+  policy buys certainty with one host sync per iteration — documented
+  cost) and skip the iteration when poisoned; training continues on the
+  next objective evaluation.
+* ``clip`` — zero out non-finite gradient/hessian entries (the poisoned
+  rows contribute nothing this iteration, like a per-row dropout) and
+  sanitize non-finite leaf outputs to 0; counts accumulate on device
+  and drain at checkpoints/teardown.
+
+Everything is counted in telemetry (``nonfinite_grad_events``,
+``nonfinite_values_clipped``, ``nonfinite_skipped_trees``) so a fleet
+dashboard sees degradation the moment it starts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..log import Log
+from ..obs import telemetry
+
+POLICIES = ("off", "raise", "skip_tree", "clip")
+
+# skip_tree escalation bound: a skip mutates nothing, so a DETERMINISTIC
+# non-finite source would silently burn every remaining iteration —
+# after this many consecutive skips the guard raises instead
+MAX_CONSECUTIVE_SKIPS = 10
+
+
+class NonFiniteError(RuntimeError):
+    """Non-finite gradients/hessians/leaf outputs under policy=raise."""
+
+
+@jax.jit
+def _count_nonfinite(grad, hess):
+    return (jnp.sum(~jnp.isfinite(grad)) + jnp.sum(~jnp.isfinite(hess))).astype(jnp.int32)
+
+
+@jax.jit
+def _clean_pair(grad, hess):
+    """Zero non-finite entries (a poisoned row drops out of this
+    iteration's tree) and report how many were cleaned."""
+    bad_g = ~jnp.isfinite(grad)
+    bad_h = ~jnp.isfinite(hess)
+    n = (jnp.sum(bad_g) + jnp.sum(bad_h)).astype(jnp.int32)
+    return (jnp.where(bad_g, 0.0, grad).astype(grad.dtype),
+            jnp.where(bad_h, 0.0, hess).astype(hess.dtype), n)
+
+
+@jax.jit
+def _count_nonfinite_leaves(leaf_value):
+    return jnp.sum(~jnp.isfinite(leaf_value)).astype(jnp.int32)
+
+
+@jax.jit
+def _clean_leaves(leaf_value):
+    bad = ~jnp.isfinite(leaf_value)
+    return jnp.where(bad, 0.0, leaf_value), jnp.sum(bad).astype(jnp.int32)
+
+
+class NonFiniteGuard:
+    """Per-booster guard state; one instance per GBDT when the policy is
+    not ``off`` (models/gbdt.py constructs it)."""
+
+    def __init__(self, policy: str) -> None:
+        if policy not in POLICIES:
+            raise ValueError(
+                f"Unknown nonfinite_policy: {policy!r} "
+                f"(valid: {', '.join(POLICIES)})")
+        self.policy = policy
+        # parked per-iteration device counts (policy=raise) — drained at
+        # the iteration's existing sync point, never a new hot-path sync
+        self._pending: List[jax.Array] = []
+        self._clipped_total = 0  # host mirror, survives checkpointing
+        self._consecutive_skips = 0
+
+    # ------------------------------------------------------------- grads
+    def check_gradients(self, grad, hess):
+        """Returns ``(grad, hess, skip_iteration)``."""
+        if self.policy == "clip":
+            grad, hess, n = _clean_pair(grad, hess)
+            self._pending.append(n)
+            self._drain_clip(limit=64)
+            return grad, hess, False
+        n = _count_nonfinite(grad, hess)
+        if self.policy == "skip_tree":
+            telemetry.host_sync()
+            if int(n) > 0:
+                telemetry.count("nonfinite_grad_events")
+                telemetry.count("nonfinite_skipped_trees")
+                self._consecutive_skips += 1
+                if self._consecutive_skips >= MAX_CONSECUTIVE_SKIPS:
+                    # a skip changes no state, so deterministic NaN
+                    # sources (inf init_score, a broken objective) would
+                    # otherwise burn EVERY remaining iteration and exit
+                    # 0 as if training succeeded — escalate instead
+                    raise NonFiniteError(
+                        f"{self._consecutive_skips} consecutive boosting "
+                        "iterations skipped for non-finite gradients "
+                        "(nonfinite_policy=skip_tree): the source is "
+                        "persistent, not transient — skipping cannot "
+                        "converge. Fix the objective/data, or use "
+                        "nonfinite_policy=clip.")
+                Log.warning(
+                    f"non-finite gradients/hessians ({int(n)} values); "
+                    "policy=skip_tree: skipping this boosting iteration")
+                return grad, hess, True
+            self._consecutive_skips = 0
+            return grad, hess, False
+        # policy == "raise": park the async count; raise_if_poisoned()
+        # materializes it at the iteration's end-of-iteration sync
+        self._pending.append(n)
+        return grad, hess, False
+
+    # ------------------------------------------------------------ leaves
+    def check_tree(self, tree):
+        """Leaf-output guard, applied before the tree's score update.
+        Returns ``(tree, handled)``.  Never drops a tree — the caller's
+        models list must stay iteration-major K-aligned — so skip_tree
+        degrades to zeroing the poisoned leaves here (gradients are the
+        skip_tree policy's skip point; a non-finite leaf with finite
+        gradients is the rare lambda/hessian-edge case)."""
+        if self.policy in ("clip", "skip_tree"):
+            cleaned, n = _clean_leaves(tree.leaf_value)
+            if self.policy == "skip_tree":
+                telemetry.host_sync()
+                if int(n) > 0:
+                    telemetry.count("nonfinite_leaf_values", int(n))
+                    telemetry.count("nonfinite_grad_events")
+                    Log.warning(
+                        f"zeroed {int(n)} non-finite leaf outputs "
+                        "(nonfinite_policy=skip_tree)")
+                    return tree._replace(leaf_value=cleaned), True
+                return tree, False
+            self._pending.append(n)
+            return tree._replace(leaf_value=cleaned), True
+        n = _count_nonfinite_leaves(tree.leaf_value)
+        self._pending.append(n)
+        return tree, False
+
+    # ----------------------------------------------------------- drains
+    def raise_if_poisoned(self, booster=None, snap=None) -> None:
+        """policy=raise drain: materialize parked counts (the caller
+        sits at a deliberate sync point already).  Restores the
+        booster to the pre-iteration ``snap`` (GBDT.snapshot_state)
+        first: a subtract-style rollback cannot work here — the NaN
+        already added into the score buffers would survive the
+        subtraction (NaN - NaN = NaN) and poison every later gradient.
+        A caller that catches the error therefore holds a genuinely
+        clean pre-iteration state."""
+        if self.policy != "raise" or not self._pending:
+            return
+        telemetry.host_sync()
+        counts = [int(v) for v in jax.device_get(self._pending)]
+        self._pending.clear()
+        bad = sum(counts)
+        if bad:
+            telemetry.count("nonfinite_grad_events")
+            if booster is not None and snap is not None:
+                booster.restore_state(snap)
+            raise NonFiniteError(
+                f"{bad} non-finite gradient/hessian/leaf values this "
+                "iteration (nonfinite_policy=raise). The booster was "
+                "restored to its exact pre-iteration state. Check the "
+                "input data (strict_data=true surfaces bad rows at load "
+                "time) or train with nonfinite_policy=skip_tree|clip to "
+                "degrade gracefully instead.")
+
+    def _drain_clip(self, limit: int = 0) -> None:
+        if self.policy != "clip" or len(self._pending) <= limit:
+            return
+        telemetry.host_sync()
+        n = sum(int(v) for v in jax.device_get(self._pending))
+        self._pending.clear()
+        if n:
+            self._clipped_total += n
+            telemetry.count("nonfinite_values_clipped", n)
+            telemetry.count("nonfinite_grad_events")
+            Log.warning(
+                f"clipped {n} non-finite gradient/hessian/leaf values "
+                "(nonfinite_policy=clip)")
+
+    def finalize(self) -> None:
+        """End-of-training / checkpoint drain for the lazy policies."""
+        self._drain_clip()
+        # raise-policy leftovers are materialized WITHOUT raising a
+        # booster rollback (training is over; the caller gets the error)
+        if self.policy == "raise" and self._pending:
+            self.raise_if_poisoned(None)
+
+    # ------------------------------------------------------ checkpointing
+    def state_dict(self) -> dict:
+        self._drain_clip()
+        return {"policy": self.policy,
+                "clipped_total": int(self._clipped_total)}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._clipped_total = int(d.get("clipped_total", 0))
+
+
+def make_guard(policy: str) -> Optional[NonFiniteGuard]:
+    return None if policy in (None, "", "off") else NonFiniteGuard(policy)

@@ -1,0 +1,172 @@
+"""Bounded retry for transient device/collective failures + a deadline
+that fails loudly instead of hanging.
+
+Two production failure shapes this covers:
+
+* **Transient errors** — a dropped TPU tunnel, a coordinator mid-restart,
+  a collective hitting a preempted peer.  These surface as exceptions
+  whose messages carry the runtime's status vocabulary (``UNAVAILABLE``,
+  ``DEADLINE_EXCEEDED``, ``connection reset`` …).  :func:`retry_transient`
+  retries exactly those, with exponential backoff and a telemetry
+  counter, and re-raises everything else immediately — an OOM or a
+  shape error must never be retried into a loop.
+* **Hangs** — a multihost collective whose peer died before joining
+  blocks FOREVER by default (jax's barrier has no library-level
+  timeout).  :func:`call_with_deadline` runs the call on a worker
+  thread and raises :class:`CollectiveDeadlineExceeded` when the clock
+  runs out.  The worker thread cannot be killed (the underlying C++
+  call is not interruptible), so the process should treat the exception
+  as fatal-but-loud: log, checkpoint state if any, exit nonzero — the
+  supervisor restarts it.  That is strictly better than a silent hang
+  that holds fleet capacity until a human notices.
+
+No jax import: the classifier works on message text, so the module
+stays importable from tools.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Sequence, TypeVar
+
+from ..log import Log
+from ..obs import telemetry
+from . import faults
+
+T = TypeVar("T")
+
+# status vocabulary of transient, retry-safe failures (XLA/gRPC wording)
+TRANSIENT_MARKERS: Sequence[str] = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "connection reset",
+    "Connection reset",
+    "Socket closed",
+    "failed to connect",
+    "Broken pipe",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(marker in msg for marker in TRANSIENT_MARKERS)
+
+
+def retry_transient(fn: Callable[[], T], *, retries: int = 3,
+                    base_delay_s: float = 0.5, max_delay_s: float = 8.0,
+                    label: str = "") -> T:
+    """Call ``fn``; on a transient failure (see :func:`is_transient`)
+    retry up to ``retries`` times with exponential backoff.  Counts
+    ``transient_retries`` in telemetry.  Non-transient exceptions and
+    the final transient failure propagate unchanged."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not is_transient(e) or attempt >= retries:
+                raise
+            attempt += 1
+            delay = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
+            telemetry.count("transient_retries")
+            Log.warning(
+                f"transient failure{f' in {label}' if label else ''} "
+                f"(attempt {attempt}/{retries}, retrying in {delay:.1f}s): "
+                f"{type(e).__name__}: {str(e)[:200]}")
+            time.sleep(delay)
+
+
+class CollectiveDeadlineExceeded(RuntimeError):
+    """A guarded collective/device call outlived its deadline.  The call
+    is still blocked on its (abandoned, daemon) worker thread — treat
+    this as fatal-but-loud: the process must exit rather than issue
+    further collectives into a wedged world."""
+
+
+def call_with_deadline(fn: Callable[[], T], deadline_s: float,
+                       what: str = "collective") -> T:
+    """Run ``fn`` with a wall-clock deadline.  ``deadline_s <= 0``
+    disables the guard (direct call).  On timeout raises
+    :class:`CollectiveDeadlineExceeded` with an actionable message."""
+    if deadline_s <= 0:
+        return fn()
+    result: list = []
+    error: list = []
+
+    def runner() -> None:
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            error.append(e)
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"deadline:{what}")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        telemetry.count("collective_deadline_hits")
+        raise CollectiveDeadlineExceeded(
+            f"{what} did not complete within {deadline_s:.0f}s — a peer "
+            "process likely died or was preempted before joining. The "
+            "call is abandoned on a daemon thread; exit this process and "
+            "re-launch the world (resume from the latest checkpoint). "
+            "Raise collective_deadline_s (or set it to 0) if the "
+            "deadline is simply too tight for this topology.")
+    if error:
+        raise error[0]
+    return result[0]
+
+
+class CollectiveFailed(RuntimeError):
+    """A dispatched collective failed.  Deliberately NOT retried on this
+    rank alone: peers that already completed the op have moved on, and a
+    unilaterally re-issued collective would match the WRONG op (silent
+    cross-rank desync — worse than the failure).  Recovery is
+    world-level: exit, re-launch all ranks, resume from checkpoint."""
+
+
+def guarded_collective(fn: Callable[[], T], *, deadline_s: float,
+                       label: str, retries: int = 2) -> T:
+    """The composition the multihost paths use: fault-injection point,
+    retry of PRE-DISPATCH failures only, and a deadline on the
+    collective itself.
+
+    The retry scope is deliberately narrow: only failures raised before
+    the collective dispatches (the chaos injection point; connection
+    setup in callers that stage it there) are transient-retried.  A
+    failure from the dispatched collective is wrapped in
+    :class:`CollectiveFailed` and raised loudly — one rank retrying a
+    matched collective while its peers have moved on desynchronizes the
+    world."""
+    retry_transient(faults.maybe_fail_collective, retries=retries,
+                    label=f"{label} (pre-dispatch)")
+    try:
+        return call_with_deadline(fn, deadline_s, what=label)
+    except CollectiveDeadlineExceeded:
+        raise
+    except BaseException as e:  # noqa: BLE001 — classified below
+        if is_transient(e):
+            raise CollectiveFailed(
+                f"{label} failed after dispatch ({type(e).__name__}: "
+                f"{str(e)[:200]}). Not retrying on this rank alone — "
+                "re-issuing a matched collective unilaterally would "
+                "desynchronize the world. Exit, re-launch all ranks "
+                "together, and resume from the latest checkpoint.") from e
+        raise
+
+
+def collective_deadline_s(cfg=None, default: float = 0.0) -> float:
+    """Resolve the configured collective deadline: the
+    ``LGBM_TPU_COLLECTIVE_DEADLINE_S`` env var wins (operator override
+    on a wedged fleet), else ``cfg.collective_deadline_s``, else
+    ``default`` (0 = disabled)."""
+    import os
+
+    env = os.environ.get("LGBM_TPU_COLLECTIVE_DEADLINE_S", "")
+    if env:
+        return float(env)
+    if cfg is not None:
+        return float(getattr(cfg, "collective_deadline_s", default) or 0.0)
+    return default

@@ -239,13 +239,60 @@ def test_wallclock_with_sync_or_host_only_is_fine():
     assert "wallclock-without-sync" not in _rules(src)
 
 
+def test_raw_artifact_write_fires():
+    # both shapes: open-for-write and json.dump into an inline open
+    src = """
+    import json
+
+    def save(path, obj):
+        with open(path, "w") as fh:
+            json.dump(obj, fh)
+
+    def save_inline(path, obj):
+        json.dump(obj, open(path, "w"))
+
+    def save_kw(path, data):
+        with open(path, mode="wb") as fh:
+            fh.write(data)
+    """
+    fs = [f for f in lint_source(textwrap.dedent(src), path="m.py")
+          if f.rule == "raw-artifact-write"]
+    assert {f.line for f in fs} == {5, 9, 12}, fs
+
+
+def test_raw_artifact_write_negative_controls():
+    # reads, appends, non-constant modes, and the atomic helpers are
+    # all exempt; a pragma'd implementation site is silent
+    src = """
+    from lightgbm_tpu.resilience.atomic import atomic_write, atomic_writer
+
+    def ok(path, obj):
+        atomic_write(path, obj)
+        with atomic_writer(path) as fh:
+            fh.write("x")
+        with open(path) as fh:          # read
+            fh.read()
+        with open(path, "a") as fh:     # append-mode log
+            fh.write("line")
+        with open(path, "r+b") as fh:   # in-place patch
+            fh.write(b"x")
+
+    def impl(tmp, mode):
+        return open(tmp, mode)          # non-constant mode
+
+    def pragma(tmp):
+        return open(tmp, "w")  # jaxlint: disable=raw-artifact-write
+    """
+    assert "raw-artifact-write" not in _rules(src)
+
+
 def test_rule_table_complete():
     # every rule the walker can emit is documented (CLI --list-rules)
     assert set(AST_RULES) == {
         "host-sync-in-jit", "python-loop-over-device-array",
         "env-read-at-trace", "f64-literal-in-traced",
         "jit-cache-miss-risk", "host-sync-in-loop",
-        "wallclock-without-sync",
+        "wallclock-without-sync", "raw-artifact-write",
     }
 
 

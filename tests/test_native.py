@@ -112,7 +112,12 @@ def test_dataset_uses_native_encode():
 
 def test_native_rejects_malformed_rows(tmp_path):
     """Ragged/garbage rows must NOT parse silently: the native parser
-    refuses and the strict python reader raises (review fix)."""
+    refuses (review fix); the python reader skips them as a counted,
+    logged ``bad_rows`` event — or raises under strict_data=true
+    (docs/resilience.md input hardening)."""
+    from lightgbm_tpu.io.parser import ParseError
+    from lightgbm_tpu.obs import telemetry
+
     p = str(tmp_path / "ragged.csv")
     with open(p, "w") as fh:
         fh.write("1,2\n1,2,3\n")
@@ -121,8 +126,12 @@ def test_native_rejects_malformed_rows(tmp_path):
     with open(p2, "w") as fh:
         fh.write("1,2.5\n1,1.5abc\n")
     assert native.parse_file(p2, "csv", False) is None
-    with pytest.raises(Exception):
-        parse_file(p2)
+    before = telemetry.get_telemetry().counter("bad_rows")
+    mat, _ = parse_file(p2)
+    assert mat.shape[0] == 1  # the garbage row is gone, not crashed on
+    assert telemetry.get_telemetry().counter("bad_rows") == before + 1
+    with pytest.raises(ParseError):
+        parse_file(p2, strict=True)
 
 
 def test_native_rejects_qid_libsvm(tmp_path):

@@ -1,0 +1,520 @@
+"""Tier-1 gate for the resilience subsystem (docs/resilience.md).
+
+The load-bearing contract: kill-at-tree-k -> resume produces a model
+file BITWISE identical to an uninterrupted run, and every fault in the
+injection matrix ends in either recovery or a loud, checksum-verified
+failure — never silent corruption.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs import telemetry
+from lightgbm_tpu.resilience import (
+    ArtifactCorrupt,
+    EXIT_PREEMPTED,
+    atomic_write,
+    atomic_write_json,
+    atomic_writer,
+    faults,
+    verify_sidecar,
+)
+from lightgbm_tpu.resilience import checkpoint as ck
+from lightgbm_tpu.resilience.faults import InjectedFault
+from lightgbm_tpu.resilience.retry import (
+    CollectiveDeadlineExceeded,
+    call_with_deadline,
+    guarded_collective,
+    retry_transient,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.clear_faults()
+    yield
+    faults.clear_faults()
+
+
+def _counter(name):
+    return telemetry.get_telemetry().counter(name)
+
+
+# ------------------------------------------------------------ atomic writes
+def test_atomic_write_and_checksum_roundtrip(tmp_path):
+    p = str(tmp_path / "a.json")
+    atomic_write_json(p, {"x": 1}, checksum=True)
+    assert json.load(open(p)) == {"x": 1}
+    digest = verify_sidecar(p)
+    assert digest and len(digest) == 64
+    # tamper -> loud, actionable refusal
+    with open(p, "a") as fh:
+        fh.write("junk")
+    with pytest.raises(ArtifactCorrupt, match="sha256"):
+        verify_sidecar(p)
+
+
+def test_atomic_write_no_sidecar_is_fine(tmp_path):
+    p = str(tmp_path / "b.txt")
+    atomic_write(p, "data")
+    assert verify_sidecar(p) is None  # checksums are opt-in
+
+
+def test_fail_write_once_leaves_destination_intact(tmp_path):
+    p = str(tmp_path / "c.txt")
+    atomic_write(p, "original", checksum=True)
+    faults.set_fault("fail_write_once")
+    with pytest.raises(InjectedFault):
+        atomic_write(p, "HALF-WRITTEN", checksum=True)
+    assert open(p).read() == "original"
+    assert verify_sidecar(p)  # artifact+sidecar pair still consistent
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    # *_once: the very next write succeeds (recovery path)
+    atomic_write(p, "new")
+    assert open(p).read() == "new"
+
+
+def test_atomic_writer_cleans_up_on_exception(tmp_path):
+    p = str(tmp_path / "d.txt")
+    atomic_write(p, "keep")
+    with pytest.raises(RuntimeError):
+        with atomic_writer(p) as fh:
+            fh.write("partial")
+            raise RuntimeError("boom mid-stream")
+    assert open(p).read() == "keep"
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+# --------------------------------------------------------- checkpoint core
+def _mini_booster(policy="off", seed=0):
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] + 0.2 * rng.randn(300) > 0).astype(np.float32)
+    cfg = Config(objective="binary", num_leaves=7, max_bin=32,
+                 min_data_in_leaf=5, bagging_fraction=0.8, bagging_freq=2,
+                 feature_fraction=0.8, nonfinite_policy=policy)
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    return cfg, ds, GBDT(cfg, ds, create_objective(cfg, ds.metadata,
+                                                   ds.num_data))
+
+
+def test_checkpoint_roundtrip_bitwise(tmp_path):
+    """THE contract: checkpoint at iteration k, restore into a fresh
+    booster, continue — the final model string is bitwise-equal to the
+    uninterrupted run's (bagging + feature_fraction active, so RNG
+    state restoration is load-bearing)."""
+    cfg, ds, b_full = _mini_booster()
+    for _ in range(6):
+        b_full.train_one_iter()
+    full = b_full.save_model_to_string()
+
+    _, _, b_half = _mini_booster()
+    for _ in range(3):
+        b_half.train_one_iter()
+    path = str(tmp_path / "ckpt_00000003.json")
+    ck.save_checkpoint(path, b_half, cfg, iteration=3)
+
+    _, _, b_res = _mini_booster()
+    payload = ck.load_checkpoint(path)
+    ck.validate_against_config(payload, cfg, path)
+    it = ck.restore_training_state(b_res, payload)
+    assert it == 3 and b_res.num_trees == 3
+    for _ in range(3):
+        b_res.train_one_iter()
+    assert b_res.save_model_to_string() == full
+
+
+def test_checkpoint_corruption_is_loud(tmp_path):
+    cfg, _, b = _mini_booster()
+    b.train_one_iter()
+    path = str(tmp_path / "ckpt_00000001.json")
+    ck.save_checkpoint(path, b, cfg, iteration=1)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        fh.write(b"A" * 16)
+    with pytest.raises(ck.CheckpointError,
+                       match="checksum|corrupted|unreadable"):
+        ck.load_checkpoint(path)
+
+
+def test_checkpoint_config_mismatch_is_loud(tmp_path):
+    cfg, _, b = _mini_booster()
+    b.train_one_iter()
+    path = str(tmp_path / "ckpt_00000001.json")
+    ck.save_checkpoint(path, b, cfg, iteration=1)
+    payload = ck.load_checkpoint(path)
+    other = Config(objective="binary", num_leaves=31)
+    with pytest.raises(ck.CheckpointError, match="fingerprint"):
+        ck.validate_against_config(payload, other, path)
+    # the resume switch itself is exempt — it is the one flag a resumed
+    # run legitimately flips
+    import dataclasses
+
+    same_but_resume = dataclasses.replace(cfg, resume=True)
+    ck.validate_against_config(payload, same_but_resume, path)
+
+
+def test_checkpoint_prune_keeps_newest(tmp_path):
+    cfg, _, b = _mini_booster()
+    b.train_one_iter()
+    d = str(tmp_path)
+    for it in (1, 2, 3, 4):
+        ck.save_checkpoint(ck.checkpoint_file(d, it), b, cfg, iteration=it)
+    ck.prune_checkpoints(d)
+    names = [os.path.basename(p) for p in ck.list_checkpoints(d)]
+    assert names == ["ckpt_00000003.json", "ckpt_00000004.json"]
+    assert ck.latest_checkpoint(d).endswith("ckpt_00000004.json")
+
+
+# ------------------------------------------------------------- CLI resume
+def _write_csv(tmp_path, rows=300, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(rows, 5)
+    y = (X[:, 0] > 0).astype(np.float64)
+    data = str(tmp_path / "d.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    return data
+
+
+def _cli(args, fault=""):
+    from lightgbm_tpu.cli import main
+
+    err = io.StringIO()
+    faults.set_fault(fault)
+    try:
+        import contextlib
+
+        with contextlib.redirect_stderr(err):
+            rc = main(args)
+    finally:
+        faults.clear_faults()
+    return rc, err.getvalue()
+
+
+def test_cli_kill_resume_bitwise(tmp_path):
+    """End-to-end through the CLI: SIGTERM (injected via the chaos
+    fault, delivered through the REAL signal handler) at iteration 3 of
+    7 -> exit 75 -> --resume -> bitwise-identical model file, manifest
+    included."""
+    data = _write_csv(tmp_path)
+    base = ["task=train", f"data={data}", "objective=binary",
+            "num_trees=7", "num_leaves=7", "min_data_in_leaf=5",
+            "bagging_fraction=0.7", "bagging_freq=2",
+            "is_save_binary_file=false"]
+    m_a = str(tmp_path / "a.txt")
+    m_b = str(tmp_path / "b.txt")
+    assert _cli(base + [f"output_model={m_a}"])[0] == 0
+    rc, err = _cli(base + [f"output_model={m_b}", "snapshot_freq=2"],
+                   fault="kill_after_tree:3")
+    assert rc == EXIT_PREEMPTED
+    assert "resume" in err  # the message tells the operator what to do
+    assert not os.path.exists(m_b)  # no model written on preemption
+    rc, _ = _cli(base + [f"output_model={m_b}", "snapshot_freq=2",
+                         "--resume"])
+    assert rc == 0
+    assert open(m_a, "rb").read() == open(m_b, "rb").read()
+    # the saved model carries its integrity sidecar
+    assert verify_sidecar(m_b) is not None
+
+
+def test_cli_resume_without_checkpoint_starts_fresh(tmp_path):
+    data = _write_csv(tmp_path, seed=12)
+    m = str(tmp_path / "m.txt")
+    rc, _ = _cli(["task=train", f"data={data}", "objective=binary",
+                  "num_trees=3", "num_leaves=7", "min_data_in_leaf=5",
+                  "is_save_binary_file=false", f"output_model={m}",
+                  "resume=true"])
+    assert rc == 0 and os.path.exists(m)
+
+
+def test_cli_resume_refuses_corrupt_checkpoint(tmp_path):
+    data = _write_csv(tmp_path, seed=13)
+    m = str(tmp_path / "m.txt")
+    base = ["task=train", f"data={data}", "objective=binary",
+            "num_trees=6", "num_leaves=7", "min_data_in_leaf=5",
+            "is_save_binary_file=false", f"output_model={m}",
+            "snapshot_freq=1"]
+    rc, _ = _cli(base, fault="kill_after_tree:2,corrupt_checkpoint")
+    assert rc == EXIT_PREEMPTED
+    rc, err = _cli(base + ["--resume"])
+    assert rc == 1
+    assert "checksum" in err or "corrupted" in err
+
+
+def test_predict_path_is_strict_about_malformed_rows(tmp_path):
+    """Prediction outputs are joined to inputs by row number: a lenient
+    skip on the predict path would silently shift every later
+    prediction onto the wrong input row, so it must RAISE instead."""
+    data = _write_csv(tmp_path, seed=21)
+    m = str(tmp_path / "m.txt")
+    assert _cli(["task=train", f"data={data}", "objective=binary",
+                 "num_trees=3", "num_leaves=7", "min_data_in_leaf=5",
+                 "is_save_binary_file=false", f"output_model={m}"])[0] == 0
+    bad = str(tmp_path / "bad_pred.csv")
+    open(bad, "w").write("0,1.0,2.0,3.0,4.0,5.0\n0,oops,2.0,3.0,4.0,5.0\n"
+                         "1,2.0,3.0,4.0,5.0,6.0\n")
+    rc, err = _cli(["task=predict", f"data={bad}", f"input_model={m}",
+                    f"output_result={tmp_path / 'p.txt'}"])
+    assert rc == 1
+    assert "malformed" in err or "strict" in err
+
+
+def test_cli_clip_policy_counts_are_drained(tmp_path):
+    """Short clip-policy runs must still report their clipped values
+    (the lazy device-count batching is drained at end of training)."""
+    data = _write_csv(tmp_path, seed=22)
+    before = _counter("nonfinite_values_clipped")
+    rc, _ = _cli(["task=train", f"data={data}", "objective=binary",
+                  "num_trees=3", "num_leaves=7", "min_data_in_leaf=5",
+                  "is_save_binary_file=false", "nonfinite_policy=clip",
+                  f"output_model={tmp_path / 'm.txt'}"],
+                 fault="nan_grads:1")
+    assert rc == 0
+    assert _counter("nonfinite_values_clipped") > before
+
+
+# -------------------------------------------------------- nonfinite guard
+def test_nan_grads_policy_raise_restores_clean_state():
+    """policy=raise must leave a genuinely usable booster: a subtract
+    rollback would keep NaN in the score buffers (NaN - NaN = NaN), so
+    the guard restores the exact pre-iteration snapshot — continuing to
+    train after catching the error must produce a finite model."""
+    from lightgbm_tpu.resilience.guards import NonFiniteError
+
+    _, _, b = _mini_booster(policy="raise")
+    b.train_one_iter()
+    faults.set_fault("nan_grads:1")
+    with pytest.raises(NonFiniteError, match="non-finite"):
+        b.train_one_iter()
+    assert b.num_trees == 1  # poisoned iteration undone
+    assert np.isfinite(np.asarray(b._scores)).all()
+    faults.clear_faults()
+    b.train_one_iter()  # recovery: training continues cleanly
+    assert b.num_trees == 2
+    assert np.isfinite(np.asarray(b._scores)).all()
+
+
+def test_skip_tree_escalates_on_persistent_nonfinite():
+    """A skip mutates nothing, so a deterministic NaN source would burn
+    every remaining iteration and exit 0 — the guard must escalate."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.resilience.guards import (
+        MAX_CONSECUTIVE_SKIPS, NonFiniteError, NonFiniteGuard)
+
+    g = NonFiniteGuard("skip_tree")
+    bad = jnp.full((1, 8), jnp.nan)
+    ok = jnp.ones((1, 8))
+    with pytest.raises(NonFiniteError, match="consecutive"):
+        for _ in range(MAX_CONSECUTIVE_SKIPS + 1):
+            g.check_gradients(bad, ok)
+    # a clean iteration resets the escalation counter
+    g2 = NonFiniteGuard("skip_tree")
+    for _ in range(MAX_CONSECUTIVE_SKIPS - 1):
+        g2.check_gradients(bad, ok)
+    g2.check_gradients(ok, ok)
+    _, _, skip = g2.check_gradients(bad, ok)
+    assert skip  # still skipping, not raising
+
+
+def test_nan_grads_policy_skip_tree():
+    _, _, b = _mini_booster(policy="skip_tree")
+    before = _counter("nonfinite_skipped_trees")
+    faults.set_fault("nan_grads:1")
+    b.train_one_iter()
+    b.train_one_iter()  # poisoned: skipped
+    b.train_one_iter()
+    assert b.num_trees == 2
+    assert _counter("nonfinite_skipped_trees") == before + 1
+
+
+def test_nan_grads_policy_clip_keeps_model_finite():
+    _, _, b = _mini_booster(policy="clip")
+    before = _counter("nonfinite_values_clipped")
+    faults.set_fault("nan_grads:1")
+    for _ in range(3):
+        b.train_one_iter()
+    b._nf_guard.finalize()
+    assert b.num_trees == 3
+    assert _counter("nonfinite_values_clipped") > before
+    s = b.save_model_to_string()
+    vals = [float(t) for line in s.splitlines()
+            if line.startswith(("leaf_value=", "internal_value="))
+            for t in line.split("=", 1)[1].split()]
+    assert all(np.isfinite(vals))
+
+
+def test_nonfinite_policy_off_has_no_guard():
+    _, _, b = _mini_booster(policy="off")
+    assert b._nf_guard is None  # default path untouched
+
+
+# ---------------------------------------------------------- retry/deadline
+def test_retry_transient_recovers_from_injected_collective():
+    before = _counter("transient_retries")
+    faults.set_fault("fail_collective_once")
+    out = guarded_collective(lambda: "ok", deadline_s=30.0, label="t")
+    assert out == "ok"
+    assert _counter("transient_retries") == before + 1
+
+
+def test_retry_transient_does_not_retry_real_errors():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("shape mismatch: not transient")
+
+    with pytest.raises(ValueError):
+        retry_transient(boom, retries=3)
+    assert len(calls) == 1
+
+
+def test_collective_deadline_fails_loudly_instead_of_hanging():
+    t0 = time.perf_counter()
+    with pytest.raises(CollectiveDeadlineExceeded, match="checkpoint"):
+        call_with_deadline(lambda: time.sleep(10), 0.3, what="test barrier")
+    assert time.perf_counter() - t0 < 5.0  # failed fast, did not hang
+
+
+def test_collective_deadline_disabled_passes_through():
+    assert call_with_deadline(lambda: 7, 0.0) == 7
+
+
+def test_dispatched_collective_failure_is_not_retried_unilaterally():
+    """A transient error FROM the collective itself must not be
+    re-issued by one rank (its peers moved on — retrying desyncs the
+    world); it surfaces as a loud CollectiveFailed instead."""
+    from lightgbm_tpu.resilience.retry import CollectiveFailed
+
+    calls = []
+
+    def flaky_collective():
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: peer went away mid-op")
+
+    with pytest.raises(CollectiveFailed, match="desynchronize"):
+        guarded_collective(flaky_collective, deadline_s=10.0, label="t")
+    assert len(calls) == 1  # dispatched exactly once
+
+
+def test_digest_writer_writelines_is_checksummed(tmp_path):
+    p = str(tmp_path / "lines.txt")
+    with atomic_writer(p, checksum=True) as fh:
+        fh.writelines(["a\n", "b\n"])
+    assert verify_sidecar(p) is not None  # digest covers ALL bytes
+
+
+# --------------------------------------------------------- input hardening
+def test_malformed_rows_lenient_and_strict(tmp_path):
+    from lightgbm_tpu.io.parser import ParseError, parse_file
+
+    p = str(tmp_path / "bad.csv")
+    open(p, "w").write("1,2.0,3.0\n0,oops,4.0\n1,5.0,6.0\n")
+    before = _counter("bad_rows")
+    mat, _ = parse_file(p)
+    assert mat.shape == (2, 3)
+    assert _counter("bad_rows") == before + 1
+    with pytest.raises(ParseError, match="strict_data"):
+        parse_file(p, strict=True)
+
+
+def test_streaming_load_degrades_on_malformed_rows(tmp_path):
+    """The chunked two-round loader cannot skip rows mid-stream (its
+    preallocation is counted up front), so malformed input falls back
+    to the one-shot lenient path — never a raw pandas crash."""
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.parser import ParseError
+
+    rows = ["%g,%g,%g" % (i % 2, i * 0.1, -i * 0.2) for i in range(60)]
+    rows[20] = "1,garbage,0.5"
+    p = str(tmp_path / "stream.csv")
+    open(p, "w").write("\n".join(rows) + "\n")
+    before = _counter("bad_rows")
+    ds = BinnedDataset.from_file(
+        p, Config(objective="binary", min_data_in_leaf=2,
+                  use_two_round_loading=True))
+    assert ds.num_data == 59
+    assert _counter("bad_rows") == before + 1
+    with pytest.raises(ParseError, match="strict_data"):
+        BinnedDataset.from_file(
+            p, Config(strict_data=True, use_two_round_loading=True))
+
+
+def test_nonfinite_labels_skipped_and_counted(tmp_path):
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.parser import ParseError
+
+    rows = ["%g,%g" % (i % 2, i * 0.1) for i in range(40)]
+    rows[5] = "inf,0.5"
+    p = str(tmp_path / "lab.csv")
+    open(p, "w").write("\n".join(rows) + "\n")
+    before = _counter("bad_rows")
+    ds = BinnedDataset.from_file(
+        p, Config(objective="binary", min_data_in_leaf=2))
+    assert ds.num_data == 39
+    assert len(ds.metadata.label) == 39
+    assert _counter("bad_rows") == before + 1
+    with pytest.raises(ParseError, match="non-finite labels"):
+        BinnedDataset.from_file(p, Config(strict_data=True))
+
+
+def test_binner_handles_inf_samples():
+    from lightgbm_tpu.io.binner import BinMapper
+
+    vals = np.array([1.0, 2.0, np.inf, 3.0, -np.inf, 4.0, 2.0, 1.0])
+    m = BinMapper.find(vals, max_bin=4)
+    assert np.isfinite(m.bin_upper_bound[:-1]).all()
+    # encoding inf still lands in a real bin (clip semantics)
+    bins = m.value_to_bin(np.array([np.inf, -np.inf, 2.5]))
+    assert (bins >= 0).all() and (bins < m.num_bin).all()
+
+
+# ----------------------------------------------------------------- chaos
+def test_chaos_dryrun_smoke():
+    """tools/chaos.py --dryrun: the full fault matrix in one process —
+    the tier-1 wiring the ISSUE asks for (every fault type proves either
+    recovery or a loud failure)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+         "--dryrun"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["failures"] == 0
+    assert set(summary["results"]) == {
+        "kill_resume", "corrupt", "fail_write", "nan_grads", "collective"}
+
+
+@pytest.mark.slow
+def test_chaos_subprocess_random_kill():
+    """The real preemption: an external SIGTERM delivered to a training
+    SUBPROCESS at a random iteration (seed printed for reproduction),
+    then resume, then bitwise comparison."""
+    seed = int(time.time()) % 100000
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+         "--scenario", "kill_resume", "--seed", str(seed)],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, (
+        f"seed={seed}\n" + r.stdout[-3000:] + r.stderr[-2000:])

@@ -96,8 +96,10 @@ def main():
         out["dp_record_vs_serial"] = round(
             out["dp_record_s_per_tree"] / out["serial_s_per_tree"], 3)
     os.makedirs(os.path.join(REPO, ".bench"), exist_ok=True)
-    with open(os.path.join(REPO, ".bench", "dp_shard_bench.json"), "w") as fh:
-        json.dump(out, fh, indent=1)
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(os.path.join(REPO, ".bench", "dp_shard_bench.json"),
+                      out, sort_keys=False)
     print(json.dumps(out), flush=True)
 
 

@@ -139,8 +139,10 @@ def main() -> None:
                 out["ref_file_s"] / out["ours_file_s"], 2)
 
     os.makedirs(os.path.join(REPO, ".bench"), exist_ok=True)
-    with open(os.path.join(REPO, ".bench", "predict_bench.json"), "w") as fh:
-        json.dump(out, fh, indent=1)
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(os.path.join(REPO, ".bench", "predict_bench.json"),
+                      out, sort_keys=False)
     print(json.dumps(out), flush=True)
 
 

@@ -250,9 +250,16 @@ def main(argv: Optional[list] = None) -> int:
     print("  " + driver_row(new))
 
     if args.json:
-        with open(args.json, "w") as fh:
+        # atomic (tmp + rename, the resilience.atomic protocol inlined —
+        # this tool stays dependency-free): a preempted benchdiff must
+        # never leave half a JSON under the artifact name
+        tmp = f"{args.json}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
             json.dump({"old": old, "new": new, "report": report}, fh,
                       indent=1)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, args.json)
     # diff semantics: 1 means "differences (regressions) found"
     return 1 if report["regressions"] else 0
 

@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""Chaos driver: exercise every resilience recovery path against real
+training runs, and FAIL loudly when one does not hold.
+
+Scenarios (each prints ``PASS``/``FAIL`` and contributes to the exit
+status; the fault matrix lives in docs/resilience.md):
+
+* ``kill_resume`` — preempt a training run (SIGTERM), resume it, assert
+  the final model file is BITWISE identical to an uninterrupted run.
+* ``corrupt``     — corrupt the checkpoint after the kill; the resume
+  attempt must refuse loudly (checksum), never train on garbage.
+* ``fail_write``  — fail an atomic_write before its rename; the
+  destination artifact must stay intact.
+* ``nan_grads``   — poison gradients mid-run; policy=raise aborts
+  loudly, policy=skip_tree finishes with a usable model.
+* ``collective``  — inject one transient collective failure; the
+  retry-with-backoff wrapper must recover.
+
+Modes:
+
+* ``--dryrun`` — everything in ONE process (cli.main called in-process,
+  faults injected programmatically): ~seconds, wired into tier-1
+  (tests/test_resilience.py).
+* default      — kill_resume/corrupt run as REAL subprocesses;
+  kill_resume delivers an external SIGTERM at a RANDOM iteration
+  (``--seed`` reproduces), which is the closest lab analog of a fleet
+  preemption.  Used by the slow-marked chaos test.
+
+Usage:
+    python tools/chaos.py --dryrun
+    python tools/chaos.py [--rows 400] [--trees 8] [--seed 7] [--keep]
+    python tools/chaos.py --scenario kill_resume
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
+             "collective")
+
+
+def log(msg: str) -> None:
+    print(f"[chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def make_data(path: str, rows: int, seed: int = 8) -> None:
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(rows, 6)
+    y = (X[:, 0] + 0.3 * rng.randn(rows) > 0).astype(np.float64)
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+
+
+def train_args(data: str, model: str, trees: int, extra=()):
+    return ["task=train", f"data={data}", "objective=binary",
+            f"num_trees={trees}", "num_leaves=7", "min_data_in_leaf=5",
+            "bagging_fraction=0.7", "bagging_freq=2",
+            "feature_fraction=0.8", "is_save_binary_file=false",
+            f"output_model={model}", *extra]
+
+
+# ------------------------------------------------------------- in-process
+def _run_inproc(args, fault: str = "") -> tuple:
+    """cli.main in this process with programmatic fault injection;
+    returns (rc, stderr_text)."""
+    from lightgbm_tpu.cli import main
+    from lightgbm_tpu.resilience import faults
+
+    err = io.StringIO()
+    faults.set_fault(fault)
+    try:
+        with contextlib.redirect_stderr(err):
+            rc = main(args)
+    finally:
+        faults.clear_faults()
+    return rc, err.getvalue()
+
+
+def scenario_kill_resume_inproc(tmp: str, trees: int, kill_at: int) -> str:
+    data = os.path.join(tmp, "d.csv")
+    make_data(data, 400)
+    m_a = os.path.join(tmp, "uninterrupted.txt")
+    m_b = os.path.join(tmp, "preempted.txt")
+    rc, _ = _run_inproc(train_args(data, m_a, trees))
+    assert rc == 0, f"uninterrupted train rc={rc}"
+    rc, _ = _run_inproc(train_args(data, m_b, trees, ["snapshot_freq=1"]),
+                        fault=f"kill_after_tree:{kill_at}")
+    assert rc == 75, f"preempted train rc={rc}, expected 75 (EX_TEMPFAIL)"
+    assert os.path.isdir(m_b + ".ckpt"), "no checkpoint dir after preemption"
+    rc, _ = _run_inproc(
+        train_args(data, m_b, trees, ["snapshot_freq=1", "--resume"]))
+    assert rc == 0, f"resume rc={rc}"
+    a, b = open(m_a, "rb").read(), open(m_b, "rb").read()
+    assert a == b, (
+        f"RESUMED MODEL DIFFERS from uninterrupted ({len(a)} vs {len(b)} "
+        "bytes) — the bitwise-identity contract is broken")
+    return f"kill at iteration {kill_at} -> resume -> bitwise-identical model"
+
+
+def scenario_corrupt_inproc(tmp: str, trees: int, kill_at: int) -> str:
+    data = os.path.join(tmp, "d2.csv")
+    make_data(data, 300, seed=9)
+    model = os.path.join(tmp, "corrupt.txt")
+    rc, _ = _run_inproc(
+        train_args(data, model, trees, ["snapshot_freq=1"]),
+        fault=f"kill_after_tree:{kill_at},corrupt_checkpoint")
+    assert rc == 75, f"preempted train rc={rc}"
+    rc, err = _run_inproc(
+        train_args(data, model, trees, ["snapshot_freq=1", "--resume"]))
+    assert rc == 1, f"resume over a corrupt checkpoint rc={rc}, expected 1"
+    assert "checksum" in err or "corrupted" in err, (
+        f"error not actionable: {err[-400:]!r}")
+    return "corrupt checkpoint -> resume refused loudly (checksum/corruption named)"
+
+
+def scenario_fail_write_inproc(tmp: str) -> str:
+    from lightgbm_tpu.resilience import atomic_write, faults
+    from lightgbm_tpu.resilience.faults import InjectedFault
+
+    target = os.path.join(tmp, "artifact.json")
+    atomic_write(target, '{"v": 1}\n')
+    faults.set_fault("fail_write_once")
+    try:
+        atomic_write(target, '{"v": 2, "half": tru')
+        raise AssertionError("injected write failure did not fire")
+    except InjectedFault:
+        pass
+    finally:
+        faults.clear_faults()
+    content = open(target).read()
+    assert content == '{"v": 1}\n', f"destination corrupted: {content!r}"
+    leftovers = [f for f in os.listdir(tmp) if f.startswith("artifact.json.tmp")]
+    assert not leftovers, f"tmp files leaked: {leftovers}"
+    return "failed write -> destination intact, no tmp litter"
+
+
+def scenario_nan_grads_inproc(tmp: str, trees: int) -> str:
+    data = os.path.join(tmp, "d3.csv")
+    make_data(data, 300, seed=10)
+    m_raise = os.path.join(tmp, "nan_raise.txt")
+    rc, err = _run_inproc(
+        train_args(data, m_raise, trees, ["nonfinite_policy=raise"]),
+        fault="nan_grads:1")
+    assert rc == 1, f"policy=raise rc={rc}, expected 1"
+    assert "non-finite" in err, f"error not actionable: {err[-300:]!r}"
+    m_skip = os.path.join(tmp, "nan_skip.txt")
+    rc, _ = _run_inproc(
+        train_args(data, m_skip, trees, ["nonfinite_policy=skip_tree"]),
+        fault="nan_grads:1")
+    assert rc == 0, f"policy=skip_tree rc={rc}"
+    assert os.path.exists(m_skip), "skip_tree produced no model"
+    return "nan grads -> raise aborts loudly, skip_tree degrades gracefully"
+
+
+def scenario_collective_inproc(tmp: str) -> str:
+    from lightgbm_tpu.resilience import faults
+    from lightgbm_tpu.resilience.retry import guarded_collective
+
+    faults.set_fault("fail_collective_once")
+    try:
+        out = guarded_collective(lambda: 42, deadline_s=30.0,
+                                 label="chaos probe")
+    finally:
+        faults.clear_faults()
+    assert out == 42
+    return "transient collective failure -> retried and recovered"
+
+
+# ------------------------------------------------------------ subprocess
+def _spawn_train(args, env_extra=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
+    return subprocess.Popen(
+        [sys.executable, "-u", "-m", "lightgbm_tpu", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=ROOT)
+
+
+def _run_train(args, env_extra=None, timeout=600):
+    p = _spawn_train(args, env_extra)
+    out, _ = p.communicate(timeout=timeout)
+    return p.returncode, out
+
+
+def scenario_kill_resume_subproc(tmp: str, trees: int, seed: int) -> str:
+    """The real thing: an EXTERNAL SIGTERM delivered at a random
+    iteration of a separate training process."""
+    data = os.path.join(tmp, "d.csv")
+    make_data(data, 400)
+    m_a = os.path.join(tmp, "uninterrupted.txt")
+    m_b = os.path.join(tmp, "preempted.txt")
+    rc, out = _run_train(train_args(data, m_a, trees))
+    assert rc == 0, f"uninterrupted train rc={rc}:\n{out[-1500:]}"
+
+    kill_at = random.Random(seed).randint(1, trees - 1)
+    log(f"will SIGTERM the training subprocess after iteration {kill_at} "
+        f"(seed={seed})")
+    p = _spawn_train(train_args(data, m_b, trees, ["snapshot_freq=1"]))
+    killed = False
+    lines = []
+    for line in p.stdout:
+        lines.append(line)
+        if not killed and f"finished iteration {kill_at}" in line:
+            p.send_signal(signal.SIGTERM)
+            killed = True
+    rc = p.wait(timeout=120)
+    out = "".join(lines)
+    if rc == 0 and not killed:
+        # the run finished before the kill landed — still a valid pass
+        # iff the model equals the uninterrupted one
+        pass
+    else:
+        assert rc == 75, f"killed run rc={rc}, expected 75:\n{out[-1500:]}"
+        rc, out = _run_train(
+            train_args(data, m_b, trees, ["snapshot_freq=1", "resume=true"]))
+        assert rc == 0, f"resume rc={rc}:\n{out[-1500:]}"
+    a, b = open(m_a, "rb").read(), open(m_b, "rb").read()
+    assert a == b, "RESUMED MODEL DIFFERS from uninterrupted run"
+    return (f"external SIGTERM after iteration {kill_at} -> exit 75 -> "
+            "resume -> bitwise-identical model")
+
+
+def scenario_corrupt_subproc(tmp: str, trees: int, kill_at: int) -> str:
+    data = os.path.join(tmp, "d2.csv")
+    make_data(data, 300, seed=9)
+    model = os.path.join(tmp, "corrupt.txt")
+    rc, out = _run_train(
+        train_args(data, model, trees, ["snapshot_freq=1"]),
+        env_extra={"LGBM_TPU_FAULT":
+                   f"kill_after_tree:{kill_at},corrupt_checkpoint"})
+    assert rc == 75, f"preempted train rc={rc}:\n{out[-1500:]}"
+    rc, out = _run_train(
+        train_args(data, model, trees, ["snapshot_freq=1", "resume=true"]))
+    assert rc == 1, f"resume over corrupt checkpoint rc={rc}"
+    assert "checksum" in out or "corrupted" in out, (
+        f"error not actionable:\n{out[-600:]}")
+    return "corrupt checkpoint -> subprocess resume refused loudly"
+
+
+# ------------------------------------------------------------------ main
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dryrun", action="store_true",
+                    help="fast in-process pass over every scenario "
+                         "(tier-1 smoke)")
+    ap.add_argument("--scenario", choices=("all",) + SCENARIOS,
+                    default="all")
+    ap.add_argument("--trees", type=int, default=8)
+    ap.add_argument("--kill-at", type=int, default=3)
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("CHAOS_SEED", "0")) or
+                    int(time.time()) % 100000)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    ap.add_argument("--json", default="",
+                    help="write a result summary JSON here (atomic)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_")
+    results = {}
+    failures = 0
+
+    def run(name, fn, *fargs):
+        if args.scenario not in ("all", name):
+            return
+        t0 = time.time()
+        try:
+            detail = fn(*fargs)
+            results[name] = {"status": "PASS", "detail": detail,
+                             "seconds": round(time.time() - t0, 1)}
+            print(f"PASS {name}: {detail}", flush=True)
+        except BaseException as e:  # noqa: BLE001 — report and continue
+            nonlocal_fail()
+            results[name] = {"status": "FAIL",
+                             "detail": f"{type(e).__name__}: {e}",
+                             "seconds": round(time.time() - t0, 1)}
+            print(f"FAIL {name}: {type(e).__name__}: {e}", flush=True)
+
+    def nonlocal_fail():
+        nonlocal failures
+        failures += 1
+
+    if args.dryrun:
+        run("kill_resume", scenario_kill_resume_inproc, tmp, args.trees,
+            args.kill_at)
+        run("corrupt", scenario_corrupt_inproc, tmp, args.trees, 2)
+        run("fail_write", scenario_fail_write_inproc, tmp)
+        run("nan_grads", scenario_nan_grads_inproc, tmp, args.trees)
+        run("collective", scenario_collective_inproc, tmp)
+    else:
+        run("kill_resume", scenario_kill_resume_subproc, tmp, args.trees,
+            args.seed)
+        run("corrupt", scenario_corrupt_subproc, tmp, args.trees,
+            args.kill_at)
+        run("fail_write", scenario_fail_write_inproc, tmp)
+        run("nan_grads", scenario_nan_grads_inproc, tmp, args.trees)
+        run("collective", scenario_collective_inproc, tmp)
+
+    summary = {"mode": "dryrun" if args.dryrun else "subprocess",
+               "seed": args.seed, "failures": failures,
+               "results": results}
+    if args.json:
+        from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+        atomic_write_json(args.json, summary)
+    print(json.dumps(summary), flush=True)
+    if args.keep:
+        log(f"scratch kept at {tmp}")
+    else:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

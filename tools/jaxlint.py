@@ -103,9 +103,9 @@ def main() -> int:
                 for k, v in measured.items()
             },
         }
-        with open(args.json, "w", encoding="utf-8") as fh:
-            json.dump(out, fh, indent=2, sort_keys=True)
-            fh.write("\n")
+        from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+        atomic_write_json(args.json, out, indent=2)
 
     for d in rel:
         print(f"{d['path']}:{d['line']}: [{d['rule']}] {d['message']}")

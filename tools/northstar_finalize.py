@@ -92,8 +92,9 @@ def main() -> None:
     if result.get("ref_sec_per_tree"):
         result["vs_ref_1core"] = round(
             result["ref_sec_per_tree"] / result["steady_sec_per_tree"], 3)
-    with open(out_path, "w") as fh:
-        json.dump(result, fh, indent=1)
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(out_path, result, sort_keys=False)
     print(json.dumps(result))
 
 

@@ -259,8 +259,9 @@ def main() -> None:
             result["ref_sec_per_tree"] / result["steady_sec_per_tree"], 3)
     os.makedirs(BENCH_DIR, exist_ok=True)
     artifact = os.path.join(BENCH_DIR, "northstar_r4.json")
-    with open(artifact, "w") as fh:
-        json.dump(result, fh, indent=1)
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(artifact, result, sort_keys=False)
     try:  # self-describing evidence next to the artifact (obs)
         from lightgbm_tpu.obs import RunManifest, manifest_path, telemetry
 

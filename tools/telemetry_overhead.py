@@ -133,9 +133,9 @@ def main() -> int:
     out = measure()
     path = os.path.join(REPO, ".bench", "telemetry_overhead.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as fh:
-        json.dump(out, fh, indent=1)
-        fh.write("\n")
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(path, out, sort_keys=False)
     print(json.dumps(out), flush=True)
     log(f"wrote {path}")
     return 0 if out["pass"] else 1
