@@ -604,6 +604,14 @@ def main() -> None:
         out.update({k: info[k] for k in (
             "warmup_iters", "warm_trees_discarded", "compile_stable",
             "compiles_warmup", "compiles_timed", "timed_trees")})
+        # phase breakdown ships INSIDE the row too (when LGBM_TPU_TRACE
+        # captured one): benchdiff.normalize already reads row["phases"]
+        # from driver BENCH artifacts, and the partition-phase gate
+        # (tests/test_bench_contract.py) arms off the committed
+        # BENCH_r0N.json's parsed row — a manifest-only breakdown would
+        # leave both blind, since the driver captures only stdout's row
+        if info.get("phases"):
+            out["phases"] = info["phases"]
         knobs = {k: os.environ[k] for k in _TUNED_KEYS if k in os.environ}
         if knobs:
             out["knobs"] = knobs

@@ -259,6 +259,27 @@ def _measure_place_runs() -> dict:
             "record_uses": uses, "record_single_use": uses == 1}
 
 
+def _measure_partition_window() -> dict:
+    """The standalone partition compaction kernel (the record-mode
+    hooks path), at its import-default routing — since PR 12 that is
+    the prefix-sum network, so its copy/convert counts are gated from
+    day one (a routing rework that reintroduces layout churn around
+    the compaction shows up here before any bench run)."""
+    import jax.numpy as jnp
+
+    from ..ops import record as rec_mod
+
+    rec, _hists, _scal_f, _meta, s, cap, k = _split_step_inputs()
+    go = jnp.zeros(cap, jnp.int32)
+    lowered = rec_mod.partition_window.lower(
+        rec, go, s["begin"], s["pcnt"], s["do_split"], cap,
+        jnp.int32(0), jnp.int32(1),
+        leaf_row=rec_mod.num_words(_F, k) + 4, interpret=True)
+    ops, has_alias, dwarn = _compile_entry(lowered)
+    return {"ops": ops, "donation": None, "donation_warnings": dwarn,
+            "has_alias": has_alias, "routing": rec_mod.ROUTING}
+
+
 def _measure_predict_matmul() -> dict:
     """The matmul predictor: 'zero indexed access' is a budget —
     gather must stay 0."""
@@ -309,6 +330,7 @@ _ENTRY_MEASURERS = {
     "split_step_window": _measure_split_step_window,
     "split_step_record_chain": _measure_split_step_record_chain,
     "place_runs": _measure_place_runs,
+    "partition_window": _measure_partition_window,
     "predict_matmul": _measure_predict_matmul,
     "post_grow_step": _measure_post_grow_step,
 }

@@ -490,12 +490,19 @@ def grow_tree(
         _Fp = _round_up(F, _FGROUP)
         # LGBM_TPU_FUSE_HIST=0 is the A/B escape hatch (read at import
         # like the other kernel knobs — see _KERN_ENV)
-        # tight VMEM gate: at Fp=248/Bp=256 (a one-hot categorical
-        # bench shape) the mega kernel's scoped VMEM measured 16.16M
-        # against the 16M limit — the hist block must stay well clear
-        # of the ~12MB routing matrices + search temporaries, so cap it
-        # at 512KB (Fp*Bp*16B); wider shapes take the 2-kernel path
-        fuse_hist = _FUSE_HIST_ENV and _Fp * _Bp * 16 <= (1 << 19)
+        # VMEM gate, routing-dependent.  onehot: at Fp=248/Bp=256 (a
+        # one-hot categorical bench shape) the mega kernel's scoped
+        # VMEM measured 16.16M against the 16M limit — the hist block
+        # must stay well clear of the ~12MB routing matrices + search
+        # temporaries, so cap it at 512KB (Fp*Bp*16B); wider shapes
+        # take the 2-kernel path.  prefix: the routing matrices are
+        # gone (the compress network's temporaries are [W+1, TILE]
+        # rows, ~KBs), so the gate loosens to 4MB and shapes like
+        # Fp=248/Bp=256 (1.0MB) keep the one-launch split step.
+        from ..ops.record import ROUTING as _REC_ROUTING
+
+        _vmem_cap = (1 << 22) if _REC_ROUTING == "prefix" else (1 << 19)
+        fuse_hist = _FUSE_HIST_ENV and _Fp * _Bp * 16 <= _vmem_cap
         direct_place = fuse_hist and _DIRECT_PLACE_ENV
         if fuse_hist:
             # constant per tree: the search kernel's [Fp, 4] meta block

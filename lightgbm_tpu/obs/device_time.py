@@ -49,8 +49,11 @@ PHASES = ("histogram", "split-search", "partition", "leaf-update",
 
 # named_scope path -> phase.  The split-step mega kernel fuses child
 # histogram accumulation INTO the partition pass (ops/record.py); its
-# device time is bucketed as partition because the routing dots, not
-# the binning math, dominate it (BASELINE.md round-5 profile).
+# device time is bucketed as partition because the row-routing work,
+# not the binning math, dominated it (the round-5 one-hot profile —
+# ~85% of device FLOPs; the prefix-sum routing default exists to close
+# exactly that gap, and keeping the bucket stable lets benchdiff
+# compare partition share across the routing change).
 SCOPE_TO_PHASE: Dict[str, str] = {
     "lgbm.histogram": "histogram",
     "lgbm.split_search": "split-search",
@@ -64,8 +67,8 @@ SCOPE_TO_PHASE: Dict[str, str] = {
 # name kept the op stem but lost the scope path
 _KERNEL_PATTERNS = (
     (re.compile(r"hist", re.I), "histogram"),
-    (re.compile(r"split_step|place|compact|partition|route|write_window",
-                re.I), "partition"),
+    (re.compile(r"split_step|place|compact|partition|route|write_window"
+                r"|compress_half|lane_cumsum", re.I), "partition"),
     (re.compile(r"best_split|search|gain", re.I), "split-search"),
     (re.compile(r"post_grow|leaf_value|shrink", re.I), "leaf-update"),
     (re.compile(r"predict|ensemble|path_table|tree_hit", re.I), "predict"),

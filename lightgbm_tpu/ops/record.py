@@ -26,14 +26,33 @@ Split-step primitives:
     contiguous range: dynamic word-row + contiguous slice + shift.
  *  ``partition_window`` — stable partition of a leaf's range by the
     split decision.  Per-tile stable compaction runs in a Pallas
-    kernel: destination positions via strict-triangular MXU dots (no
-    cumsum lowering), a one-hot routing matrix applied to the four i32
-    byte planes (bytes and 0/1 flags are exact in bf16, f32
-    accumulation — the dots are EXACT at default MXU precision), and
-    in-order sliced async DMA placing each tile's left/right runs at
-    their global offsets — later tiles overwrite earlier garbage tails
-    because TPU grids execute sequentially.  Zero per-element
-    descriptors anywhere.
+    kernel under one of TWO routing strategies (``LGBM_TPU_REC_ROUTING``,
+    read once at import; kernels also take an explicit ``routing=``
+    static arg so tools/kernel_ab.py can A/B both in one process):
+
+    - ``prefix`` (DEFAULT): per-tile prefix-sum routing.  A lane
+      cumsum over the go bitmask yields each column's destination
+      offset directly — left rows land at ``cumsum(go)-1``, right rows
+      at ``cumsum(1-go)-1`` in the right half — and the columns move
+      through an LSB-first staged-shift compress network (Hacker's
+      Delight 7-4), ``2*ceil(log2(TILE))`` roll+select steps on the
+      VPU: O(TILE*log TILE) work per tile, O(n*log TILE) per level.
+    - ``onehot``: the round-3 design this replaced.  Destination
+      positions via strict-triangular MXU dots (no cumsum lowering), a
+      one-hot routing matrix applied to the four i32 byte planes
+      (bytes and 0/1 flags are exact in bf16, f32 accumulation — the
+      dots are EXACT at default MXU precision): O(TILE^2) MXU work per
+      tile, O(n*TILE) per level — ~85% of device FLOPs at 10M rows
+      moved rows instead of binning them (PR 10 phase attribution).
+      Kept selectable as the chip-validated fallback and A/B baseline.
+
+    Both routings produce BITWISE-IDENTICAL final partitions (pinned
+    by tests/test_partition_routing.py and tools/kernel_ab.py): the
+    runs' garbage tails differ, but every consumer masks or overwrites
+    garbage lanes by the run counts.  Placement is in-order sliced
+    async DMA landing each tile's left/right runs at their global
+    offsets — later tiles overwrite earlier garbage tails because TPU
+    grids execute sequentially.  Zero per-element descriptors anywhere.
  *  ``unpack_window`` — a child's contiguous [W, cap] slice back to
     (bins, grad, hess, mask) for the histogram kernels: vectorized
     shifts, no indexed access.
@@ -52,8 +71,10 @@ from ..obs.device_time import phase_scope
 
 import os as _os
 
-# partition tile width; larger tiles halve the placement-scan step count
-# at quadratically more (cheap) MXU routing work per tile
+# partition tile width; larger tiles halve the placement-scan step
+# count at more routing work per tile — quadratically more MXU dots
+# under onehot routing, one extra compress stage per doubling under
+# prefix routing (see ROUTING below)
 TILE = int(_os.environ.get("LGBM_TPU_REC_TILE", "512"))
 if TILE <= 0 or TILE % 128 != 0:
     raise ValueError(
@@ -71,6 +92,20 @@ PLACE_CHUNK = int(_os.environ.get("LGBM_TPU_PLACE_CHUNK", "16384"))
 if PLACE_CHUNK <= 0:
     raise ValueError(
         f"LGBM_TPU_PLACE_CHUNK must be positive, got {PLACE_CHUNK}")
+# partition compaction routing strategy (module docstring): "prefix" =
+# lane-cumsum destination offsets + staged-shift compress network
+# (O(TILE*log TILE)/tile), "onehot" = the [TILE, 2*TILE] MXU routing
+# dots (O(TILE^2)/tile, the round-3 design, kept as A/B baseline and
+# chip-validated fallback).  Read ONCE at import like the other kernel
+# knobs (ADVICE r4): the kernels read it at trace time, and jit caches
+# key only on shapes/static args, so a mid-process env flip would
+# silently half-apply.  The kernels' explicit ``routing=`` static arg
+# is the in-process override for A/B tooling.
+ROUTING = _os.environ.get("LGBM_TPU_REC_ROUTING", "prefix")
+if ROUTING not in ("onehot", "prefix"):
+    raise ValueError(
+        f"LGBM_TPU_REC_ROUTING must be 'onehot' or 'prefix', "
+        f"got {ROUTING!r}")
 
 
 def round_up(x: int, m: int) -> int:
@@ -210,15 +245,116 @@ def _tile_go(tile, scal_i_ref, i, *, F, k):
     return (go * valid).astype(jnp.float32)
 
 
-def _compact_body(tile, g, W):
-    """Shared MXU one-hot stable-compaction math (used by both the plain
-    and the fused kernel): route tile columns so lefts land in [0, T)
-    and everything else in [T, 2T), original order inside each.
+def _resolve_routing(routing):
+    """None -> the import default; anything else must be a known
+    strategy (an unrecognized string silently meaning 'onehot' would
+    make A/B tooling lie)."""
+    routing = routing or ROUTING
+    if routing not in ("onehot", "prefix"):
+        raise ValueError(
+            f"routing must be 'onehot' or 'prefix', got {routing!r}")
+    return routing
+
+
+def _lane_cumsum(g):
+    """Inclusive prefix sum along the LANE axis of a [1, T] i32 row:
+    ceil(log2(T)) Hillis-Steele roll+mask stages.  Mosaic has no
+    reliable cumsum lowering on the lane axis; ``pltpu.roll`` plus an
+    iota mask (arithmetic, no i1 select) is the portable scan — and it
+    runs identically under interpret mode, so CPU parity tests exercise
+    the same math the chip does."""
+    T = g.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, g.shape, 1)
+    c = g
+    step = 1
+    while step < T:
+        # lane t accumulates lane t-step; the iota mask zeroes the
+        # wrapped lanes (< step), so the circular roll acts as a shift.
+        # jnp.where (i1 pred, i32 operands — the _tile_go row-pick
+        # pattern) instead of a cast-and-multiply: a select lowers with
+        # no convert op, keeping the hlo_audit convert budget tight
+        c = c + jnp.where(lane >= step, pltpu.roll(c, step, axis=1), 0)
+        step *= 2
+    return c
+
+
+def _compress_half(tile, live, shift, nbits):
+    """Stable left-compaction of the ``live`` columns of one [R, T]
+    tile: column t moves LEFT by ``shift[t]`` lanes (its lane minus its
+    prefix-sum destination), applied as LSB-first staged moves of 2^j
+    lanes — the Hacker's Delight 7-4 'compress' network.  Monotone
+    zero-count shifts make the stages conflict-free: a live column with
+    bit j still pending sits at lane >= 2^j (its destination is >= 0),
+    so no live column ever wraps or lands on another live column.
+
+    The shift row rides the tile (one extra sublane) so it moves WITH
+    its column; ``live`` [1, T] i32 gates every move — vacated lanes
+    carry stale values but a dead live flag, and dead lanes can never
+    move or be kept.  Returns [R, T] with the live columns compacted to
+    [0, count) in original order and GARBAGE beyond — every consumer
+    masks or overwrites garbage lanes via the run counts (same contract
+    as the one-hot path's zero lanes, which were equally meaningless).
+    """
+    R = tile.shape[0]
+    T = tile.shape[-1]
+    work = jnp.concatenate([tile, shift], axis=0)  # [R+1, T]
+    for j in range(nbits):
+        step = 1 << j
+        # left-rotate by ``step``: lane t sees lane t+step (pltpu.roll
+        # shifts toward higher lanes, so rotate by T-step)
+        r_work = pltpu.roll(work, T - step, axis=1)
+        r_live = pltpu.roll(live, T - step, axis=1)
+        move_in = r_live * ((r_work[R: R + 1, :] >> j) & 1)  # [1, T]
+        stay = live * (1 - ((work[R: R + 1, :] >> j) & 1))
+        # arithmetic select (move_in is exact 0/1); stay and move_in
+        # are disjoint on live lanes by the conflict-freedom argument
+        work = move_in * r_work + (1 - move_in) * work
+        live = jnp.maximum(move_in, stay)
+    return work[:R]
+
+
+def _prefix_compact_body(tile, g, W):
+    """Prefix-sum routing (the ``routing="prefix"`` default): the
+    O(TILE*log TILE) replacement for the one-hot MXU compaction below.
+    A lane cumsum of the go row yields destination offsets directly —
+    lefts land at ``cumsum(go)-1``, everything else (the invalid tail
+    included, exactly like the one-hot path) at ``cumsum(1-go)-1`` in
+    the right half — and the columns move through two compress
+    networks (2*ceil(log2(T)) roll+select stages) instead of [T, 2T]
+    routing dots.  The i32 words move untouched (no bf16 byte-plane
+    round trip), so routed content is exact by construction.
+
+    tile [W, T] i32, g [1, T] 0/1 row (f32 or i32; 1 = left AND valid)
+    -> [W, 2T]: lefts compacted to [0, T), everything else to [T, 2T),
+    original order inside each, garbage lanes beyond each run.
+    """
+    T = tile.shape[-1]
+    gi = g.astype(jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    csum = _lane_cumsum(gi)  # inclusive left count per lane
+    nbits = (T - 1).bit_length()
+    # go column at lane t: dest = csum[t]-1, shift = t - csum[t] + 1
+    # (= non-go count strictly below t); non-go column: dest =
+    # t - csum[t], shift = csum[t] (= go count strictly below t)
+    left = _compress_half(tile, gi, lane - csum + 1, nbits)
+    right = _compress_half(tile, 1 - gi, csum, nbits)
+    return jnp.concatenate([left, right], axis=1)
+
+
+def _compact_body(tile, g, W, routing=None):
+    """Shared stable-compaction math (used by both the plain and the
+    fused kernel): route tile columns so lefts land in [0, T) and
+    everything else in [T, 2T), original order inside each.
+
+    ``routing`` (static; None = module default ROUTING) picks the
+    prefix-sum network (above) or the one-hot MXU dots (below).
 
     tile [W, T] i32, g [1, T] f32 ROW (1.0 = left, valid only) ->
     [W, 2T].  The row form contracts directly on the lane axis — no
     [1,T]->[T,1] in-kernel relayout and no column operand from XLA.
     """
+    if _resolve_routing(routing) == "prefix":
+        return _prefix_compact_body(tile, g, W)
     T = TILE
     # strict-lower triangular: Lt[t, b] = 1.0 iff b < t; positions via
     # MXU dots (inputs 0/1 -> exact at any precision, f32 accumulation)
@@ -273,10 +409,14 @@ def _route_bytes(tile, pos, W):
 
 
 def _compact_body_col(tile, g, W):
-    """Column-operand variant of _compact_body (g [T, 1] f32): used by
-    partition_window, whose go flags arrive as an explicit vector (a
-    [nt, T] row-block operand is not a legal Mosaic block shape —
-    sublane dim 1 — while the [cap, 1] column's (T, 1) block is)."""
+    """Column-operand variant of the ONE-HOT _compact_body (g [T, 1]
+    f32): used by partition_window's ``routing="onehot"`` kernel, whose
+    go flags arrive as an explicit vector (a [nt, T] row-block operand
+    is not a legal Mosaic block shape — sublane dim 1 — while the
+    [cap, 1] column's (T, 1) block is).  The prefix path has no column
+    variant: its compress network runs on the lane axis, so
+    partition_window ships the go row sublane-aligned instead (see
+    _compact_kernel_prefix)."""
     T = TILE
     t_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
     b_i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
@@ -292,7 +432,8 @@ def _compact_body_col(tile, g, W):
 
 
 def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
-    """One grid step = one [W, T] tile: MXU one-hot stable compaction.
+    """One grid step = one [W, T] tile: MXU one-hot stable compaction
+    (partition_window, ``routing="onehot"``).
 
     win_ref  [W, T] i32    : this tile of the record window
     gcol_ref [T, 1] i32    : go flags (1 = left, valid only)
@@ -305,6 +446,17 @@ def _compact_kernel(win_ref, gcol_ref, out_ref, *, W):
     """
     out_ref[0] = _compact_body_col(
         win_ref[...], gcol_ref[...].astype(jnp.float32), W)
+
+
+def _compact_kernel_prefix(win_ref, grow_ref, out_ref, *, W):
+    """One grid step = one [W, T] tile: prefix-sum stable compaction
+    (partition_window, ``routing="prefix"``).  Same grid and output
+    contract as _compact_kernel, but the go flags arrive as ROW 0 of a
+    sublane-aligned [8, T] operand — the compress network runs on the
+    lane axis, and a bare [1, cap] row block (sublane dim 1) is not
+    Mosaic-legal while the one-hot path's [cap, 1] column would need an
+    in-kernel relayout to reach the lanes."""
+    out_ref[0] = _prefix_compact_body(win_ref[...], grow_ref[0:1, :], W)
 
 
 
@@ -364,9 +516,25 @@ def _hist_tile_body(tile, scal_i_ref, hacc_set, *, W, F, k, Bp,
             hacc_set(fi, contrib0)
 
 
-# NOTE: the round-4 fused compact+hist kernel pair (_compact_hist_kernel /
-# partition_hist_window) was deleted in round 5: split_step_window
-# superseded it and it had no callers left (ADVICE r4).
+# NOTE on lineage: the round-4 fused compact+hist kernel pair
+# (_compact_hist_kernel / partition_hist_window) was deleted in round 5
+# — split_step_window superseded it (ADVICE r4).  Through round 6 every
+# surviving compaction path routed via the one-hot MXU dots; round 7
+# added the prefix-sum routing above and made it the default, keeping
+# one-hot selectable (LGBM_TPU_REC_ROUTING / the kernels' ``routing=``
+# static arg) as the A/B baseline and chip-validated fallback.  See the
+# module docstring for the two strategies' cost model.
+
+
+def _run_offsets(cl, cr):
+    """Exclusive per-tile start offsets of the left/right runs within
+    their halves, from the per-tile left/right counts [nt].  ONE
+    definition of the offset convention — place_runs, split_step_window
+    and partition_window all consume it, so the three (previously
+    duplicated) constructions cannot drift apart."""
+    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
+    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+    return loff, roff
 
 
 def _xla_place(rec, win, comp, loff, roff, nleft, iota, valid, do_split,
@@ -494,13 +662,14 @@ def write_window(rec, out_win, begin, cap: int, interpret: bool = False):
 
 
 def _split_tile(tile, scal_i_ref, j, comp_ref, cnt_ref, hacc_ref, *,
-                W, F, k, Bp, fgroup):
+                W, F, k, Bp, fgroup, routing=None):
     """Per-tile work of the split step: ONE in-kernel go computation
     (no [cap, 1] column operand from XLA — see _tile_go) shared by the
-    MXU compaction, the per-tile left-count output, and the left-child
-    histogram accumulation.  ``j`` is the tile ordinal (validity)."""
+    compaction (prefix or one-hot, per ``routing``), the per-tile
+    left-count output, and the left-child histogram accumulation.
+    ``j`` is the tile ordinal (validity)."""
     govf = _tile_go(tile, scal_i_ref, j, F=F, k=k)
-    comp_ref[0] = _compact_body(tile, govf, W)
+    comp_ref[0] = _compact_body(tile, govf, W, routing=routing)
     cnt_ref[...] = jnp.zeros((1, 128), jnp.int32) + jnp.sum(
         govf).astype(jnp.int32)
 
@@ -513,7 +682,7 @@ def _split_tile(tile, scal_i_ref, j, comp_ref, cnt_ref, hacc_ref, *,
 
 def _split_step_kernel(
     scal_i_ref, scal_f_ref, *refs,
-    W, F, k, Bp, nt, fgroup=8, direct_read=False,
+    W, F, k, Bp, nt, fgroup=8, direct_read=False, routing=None,
 ):
     """The WHOLE split step in one launch: per-tile MXU compaction +
     left-child histogram accumulation (steps 0..nt-1), then subtract +
@@ -594,7 +763,7 @@ def _split_step_kernel(
                 tile = ra * m + rb * (1 - m)
                 _split_tile(tile, scal_i_ref, i - 1, comp_ref, cnt_ref,
                             hacc_ref, W=W, F=F, k=k, Bp=Bp,
-                            fgroup=fgroup)
+                            fgroup=fgroup, routing=routing)
 
             prev_ref[...] = cur
     else:
@@ -607,7 +776,8 @@ def _split_step_kernel(
             # search still needs
             hists_out_ref[0] = hrow_ref[0]
             _split_tile(win_ref[...], scal_i_ref, i, comp_ref, cnt_ref,
-                        hacc_ref, W=W, F=F, k=k, Bp=Bp, fgroup=fgroup)
+                        hacc_ref, W=W, F=F, k=k, Bp=Bp, fgroup=fgroup,
+                        routing=routing)
 
     @pl.when(i >= nt + off)
     def _():
@@ -768,8 +938,7 @@ def place_runs(
         kt = gov.reshape(nt, T)
         cl = jnp.sum(kt, axis=1, dtype=jnp.int32)
         cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
-    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
-    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+    loff, roff = _run_offsets(cl, cr)
 
     if interpret:
         # reference placement (the XLA path the kernel replaces)
@@ -818,7 +987,7 @@ def place_runs(
 @functools.partial(
     jax.jit,
     static_argnames=("F", "cap", "k", "fgroup", "return_comp",
-                     "interpret"),
+                     "interpret", "routing"),
     donate_argnums=(0,),
 )
 @phase_scope("split_step")
@@ -834,6 +1003,7 @@ def split_step_window(
     fgroup: int = 8,
     return_comp: bool = False,
     interpret: bool = False,
+    routing: str | None = None,  # compaction routing (None = ROUTING)
 ):
     """One-launch split step over window [begin, begin+cap): compaction
     + left-child histogram + subtract + two-child search + in-place
@@ -942,7 +1112,7 @@ def split_step_window(
     outs = pl.pallas_call(
         functools.partial(
             _split_step_kernel, W=W, F=F, k=k, Bp=Bp, nt=nt,
-            fgroup=fgroup, direct_read=direct_read),
+            fgroup=fgroup, direct_read=direct_read, routing=routing),
         grid_spec=grid_spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
@@ -964,8 +1134,7 @@ def split_step_window(
     if return_comp:
         return hists_new, comp, nleft, res, cl, cr, rec_pass
 
-    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
-    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+    loff, roff = _run_offsets(cl, cr)
     iota = jnp.arange(cap, dtype=jnp.int32)
     valid = (iota < pcnt).astype(jnp.int32)
     win = (data_in[0] if not direct_read
@@ -978,7 +1147,8 @@ def split_step_window(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cap", "leaf_row", "direct", "interpret"))
+    jax.jit, static_argnames=("cap", "leaf_row", "direct", "interpret",
+                              "routing"))
 @phase_scope("partition")
 def partition_window(
     rec: jax.Array,  # [W, n_pad] i32 (aliased in-kernel when direct)
@@ -992,6 +1162,7 @@ def partition_window(
     leaf_row: int = -1,  # record row to stamp child leaf ids into
     direct: bool = False,  # aliased in-kernel placement (place_runs)
     interpret: bool = False,
+    routing: str | None = None,  # compaction routing (None = ROUTING)
 ):
     """Stably partition window [begin, begin+cap) of ``rec``: the
     parent's rows [0, pcnt) become left-rows ++ right-rows (original
@@ -1000,7 +1171,9 @@ def partition_window(
     exactly.  Returns (rec', nleft).  DataPartition::Split
     (data_partition.hpp:91-139) re-designed for the TPU memory system.
     With ``leaf_row`` >= 0 the child leaf ids are stamped over the
-    parent's kept range (see rec_height's leaf-id row).
+    parent's kept range (see rec_height's leaf-id row).  ``routing``
+    picks the compaction strategy (module docstring); both produce
+    bitwise-identical results (tests/test_partition_routing.py).
     """
     W = rec.shape[0]
     T = TILE
@@ -1023,20 +1196,35 @@ def partition_window(
     # each right-run's valid prefix lands at the right global offset;
     # the garbage beyond total-valid-rights is cut by the final selects
     cr = jnp.sum(valid.reshape(nt, T) - kt, axis=1, dtype=jnp.int32)
-    loff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cl)])[:-1]
-    roff = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(cr)])[:-1]
+    loff, roff = _run_offsets(cl, cr)
 
-    comp = pl.pallas_call(
-        functools.partial(_compact_kernel, W=W),
-        grid=(nt,),
-        in_specs=[
-            pl.BlockSpec((W, T), lambda i: (0, i)),
-            pl.BlockSpec((T, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, W, 2 * T), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
-        interpret=interpret,
-    )(win, gov.reshape(cap, 1))
+    if _resolve_routing(routing) == "prefix":
+        # go flags ride ROW 0 of a sublane-aligned [8, cap] operand
+        # (see _compact_kernel_prefix); rows 1-7 are zero padding
+        gov8 = jnp.pad(gov[None], ((0, 7), (0, 0)))
+        comp = pl.pallas_call(
+            functools.partial(_compact_kernel_prefix, W=W),
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((W, T), lambda i: (0, i)),
+                pl.BlockSpec((8, T), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, W, 2 * T), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
+            interpret=interpret,
+        )(win, gov8)
+    else:
+        comp = pl.pallas_call(
+            functools.partial(_compact_kernel, W=W),
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((W, T), lambda i: (0, i)),
+                pl.BlockSpec((T, 1), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, W, 2 * T), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((nt, W, 2 * T), jnp.int32),
+            interpret=interpret,
+        )(win, gov.reshape(cap, 1))
 
     if direct and not interpret:
         # aliased in-kernel placement: no scan-of-DUS and no copy of

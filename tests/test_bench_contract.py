@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -60,6 +62,50 @@ def test_bench_always_emits_json_line(tmp_path):
     assert man.warmup["compiles_warmup"] >= 1
     assert man.per_tree.get("count") == out["timed_trees"]
     assert isinstance(man.phases, dict)  # empty unless LGBM_TPU_TRACE
+
+
+def test_bench_r06_partition_phase_gate():
+    """CI contract for the prefix-routing rewrite (ISSUE 12): any newly
+    committed BENCH_r06.json must (a) pass tools/benchdiff.py against
+    BENCH_r05.json — no headline/phase/compile regression — and (b) not
+    regress the partition-phase share vs the committed one-hot baseline
+    (.bench/partition_phase_baseline.json).  Skips until a driver bench
+    commits BENCH_r06.json; from that moment the gate is armed — a
+    partition share at or above the one-hot era's ~87% means the
+    routing rewrite did not reach the chip."""
+    r06 = os.path.join(ROOT, "BENCH_r06.json")
+    if not os.path.exists(r06):
+        pytest.skip("no BENCH_r06.json committed yet (needs a TPU run)")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "benchdiff.py"),
+         os.path.join(ROOT, "BENCH_r05.json"), r06],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 0, (
+        f"benchdiff BENCH_r05 -> BENCH_r06 flagged:\n{r.stdout}\n{r.stderr}")
+
+    with open(os.path.join(ROOT, ".bench",
+                           "partition_phase_baseline.json")) as fh:
+        base = json.load(fh)
+    with open(r06) as fh:
+        row = json.load(fh).get("parsed") or {}
+    phases = row.get("phases") or {}
+    part = float(phases.get("partition") or 0.0)
+    hist = float(phases.get("histogram") or 0.0)
+    if part <= 0 or hist <= 0:
+        pytest.skip("BENCH_r06 carries no partition+histogram phase "
+                    "attribution (capture one with LGBM_TPU_TRACE=<dir> "
+                    "bench.py)")
+    # SAME denominator as the baseline: partition / (partition +
+    # histogram) — the baseline's 0.87 was pinned from exactly those
+    # two phases, and a share over all phases would let an unchanged
+    # partition time sneak under the bar just because other phases
+    # exist in the new capture
+    share = part / (part + hist)
+    assert share < base["max_partition_share"], (
+        f"partition/(partition+histogram) share {share:.2f} has not "
+        f"improved on the one-hot baseline "
+        f"{base['partition_share']:.2f} — the routing rewrite "
+        f"regressed or never engaged", phases)
 
 
 def _inprocess_bench_run(bench):

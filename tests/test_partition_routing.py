@@ -1,0 +1,168 @@
+"""Bitwise parity of the two partition-routing strategies (ISSUE 12).
+
+``onehot`` (the round-3 [TILE, 2*TILE] MXU routing dots) and ``prefix``
+(lane-cumsum destination offsets + the staged-shift compress network,
+the import default since PR 12) must produce BYTE-IDENTICAL partitioned
+records — the compacted runs' garbage tails may differ, but everything
+the placement keeps must match exactly.  Property-style: random go
+patterns across TILE in {128, 256, 512}, ragged window caps, all-left /
+all-right / empty-leaf edges, and with the bagging-mask word populated.
+
+The tests call ``partition_window.__wrapped__`` (the un-jitted body):
+the jit cache keys on shapes/static args but NOT on the module TILE
+global, so a monkeypatched TILE would silently hit a stale trace.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu.ops.record as R
+
+_F, _B = 6, 16
+
+
+def _mkrec(n, n_pad, seed=0, bag_frac=None):
+    """A populated record: packed bins + grad/hess + bagging-mask word
+    (routed as data like every other word-row) + row/leaf-id rows."""
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, _B, (_F, n)).astype(np.uint8)
+    bag = (np.ones(n, np.float32) if bag_frac is None
+           else (rng.rand(n) < bag_frac).astype(np.float32))
+    rec = R.build_record(
+        jnp.asarray(bins),
+        jnp.asarray(rng.randn(n).astype(np.float32)),
+        jnp.asarray((np.abs(rng.randn(n)) + 0.1).astype(np.float32)),
+        jnp.asarray(bag),
+        n_pad,
+    )
+    return rec
+
+
+def _partition_bytes(rec, go, begin, pcnt, cap, routing, do_split=True,
+                     leaf_row=None):
+    k = R.bins_per_word(jnp.uint8)
+    out, nleft = R.partition_window.__wrapped__(
+        rec, jnp.asarray(go, jnp.int32), jnp.int32(begin),
+        jnp.int32(pcnt), jnp.bool_(do_split), cap,
+        left_leaf=jnp.int32(0), right_leaf=jnp.int32(1),
+        leaf_row=(R.num_words(_F, k) + 4 if leaf_row is None else leaf_row),
+        interpret=True, routing=routing)
+    return np.asarray(out).tobytes(), int(nleft)
+
+
+@pytest.fixture(autouse=True)
+def _restore_tile(monkeypatch):
+    # every test in this module may monkeypatch R.TILE; ensure the
+    # import-time value is back afterwards no matter what
+    tile = R.TILE
+    yield
+    R.TILE = tile
+
+
+@pytest.mark.parametrize("tile", [128, 256, 512])
+def test_routing_parity_random_windows(tile, monkeypatch):
+    """Random go patterns over multi-tile windows, ragged pcnt."""
+    monkeypatch.setattr(R, "TILE", tile)
+    rng = np.random.RandomState(tile)
+    n = 3 * tile - 57  # ragged: the window's invalid tail is nonempty
+    cap = 3 * tile
+    rec = _mkrec(n, cap + tile, seed=tile, bag_frac=0.7)
+    for trial in range(3):
+        go = (rng.rand(cap) < rng.choice([0.1, 0.5, 0.9])).astype(np.int32)
+        a = _partition_bytes(rec, go, 0, n, cap, "onehot")
+        b = _partition_bytes(rec, go, 0, n, cap, "prefix")
+        assert a == b, (tile, trial)
+
+
+@pytest.mark.parametrize("tile", [128, 512])
+def test_routing_parity_edges(tile, monkeypatch):
+    """All-left, all-right, empty leaf, and a no-op split."""
+    monkeypatch.setattr(R, "TILE", tile)
+    cap = 2 * tile
+    n = cap - 13
+    rec = _mkrec(n, cap + tile, seed=1, bag_frac=0.5)
+    cases = [
+        (np.ones(cap, np.int32), n, True),    # all-left
+        (np.zeros(cap, np.int32), n, True),   # all-right
+        (np.ones(cap, np.int32), 0, True),    # empty leaf (pcnt = 0)
+        (np.random.RandomState(2).randint(0, 2, cap).astype(np.int32),
+         n, False),                            # do_split = False no-op
+    ]
+    for go, pcnt, do_split in cases:
+        a = _partition_bytes(rec, go, 0, pcnt, cap, "onehot",
+                             do_split=do_split)
+        b = _partition_bytes(rec, go, 0, pcnt, cap, "prefix",
+                             do_split=do_split)
+        assert a == b, (tile, pcnt, do_split)
+    # the all-left case really moved every valid row left
+    go = np.ones(cap, np.int32)
+    _, nleft = _partition_bytes(rec, go, 0, n, cap, "prefix")
+    assert nleft == n
+
+
+def test_routing_parity_interior_window(monkeypatch):
+    """A window that does not start at the record origin (begin > 0,
+    unaligned to TILE is not legal — begin is tile-aligned in the tier
+    chain — but a nonzero begin exercises the write-back offsets)."""
+    tile = R.TILE
+    cap = 2 * tile
+    n = 3 * tile
+    rec = _mkrec(n, n + cap, seed=3, bag_frac=0.6)
+    rng = np.random.RandomState(4)
+    go = rng.randint(0, 2, cap).astype(np.int32)
+    a = _partition_bytes(rec, go, tile, cap - 100, cap, "onehot")
+    b = _partition_bytes(rec, go, tile, cap - 100, cap, "prefix")
+    assert a == b
+
+
+def test_split_step_window_routing_parity():
+    """The fused mega-kernel path: all four outputs (hists, rec, nleft,
+    res) byte-identical across routings at the hlo_audit pinned shape."""
+    from lightgbm_tpu.analysis.hlo_audit import _split_step_inputs
+
+    outs = {}
+    for routing in ("onehot", "prefix"):
+        # fresh inputs per routing: hists is donated
+        rec, hists, scal_f, meta, s, cap, k = _split_step_inputs()
+        o = R.split_step_window(
+            hists, rec, s["begin"], s["pcnt"], s["do_split"], s["f"],
+            s["thr"], s["is_cat"], s["parent_slot"], s["new_slot"],
+            scal_f, meta, F=4, cap=cap, k=k, interpret=True,
+            routing=routing)
+        outs[routing] = [np.asarray(x) for x in o]
+    for name, a, b in zip(("hists", "rec", "nleft", "res"),
+                          outs["onehot"], outs["prefix"]):
+        assert a.tobytes() == b.tobytes(), name
+
+
+def test_routing_knob_validates():
+    """The import-time knob only accepts the two strategies, and the
+    module default is one of them (prefix since PR 12)."""
+    assert R.ROUTING in ("onehot", "prefix")
+    with pytest.raises(Exception):
+        R.partition_window.__wrapped__(
+            _mkrec(64, 2 * R.TILE), jnp.zeros(R.TILE, jnp.int32),
+            jnp.int32(0), jnp.int32(64), jnp.bool_(True), R.TILE,
+            interpret=True, routing="bogus")
+
+
+def test_prefix_lane_cumsum_matches_numpy():
+    """The in-kernel Hillis-Steele scan is exactly an inclusive cumsum
+    (pltpu.roll only evaluates inside a kernel, so run it through a
+    one-block interpret pallas_call)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kern(g_ref, o_ref):
+        o_ref[...] = R._lane_cumsum(g_ref[...])
+
+    rng = np.random.RandomState(0)
+    for T in (128, 256, 512):
+        g = rng.randint(0, 2, (1, T)).astype(np.int32)
+        got = np.asarray(pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((1, T), jnp.int32),
+            interpret=True)(jnp.asarray(g)))
+        np.testing.assert_array_equal(got, np.cumsum(g[0])[None])

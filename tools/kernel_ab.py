@@ -1,6 +1,20 @@
-"""A/B the histogram kernel variants + end-to-end growth modes on TPU.
+"""A/B the partition-routing strategies, histogram kernel variants and
+end-to-end growth modes.
 
-Run when the chip is reachable:  python tools/kernel_ab.py [rows]
+Routing A/B (runs first, works on CPU AND TPU — the parity and FLOP
+halves of ISSUE 12's acceptance):
+
+  python tools/kernel_ab.py --routing-only [rows]
+
+asserts the ``onehot`` and ``prefix`` partition compactions produce
+BITWISE-IDENTICAL records (partition_window + the fused split step,
+in one process via the kernels' ``routing=`` static arg — this is why
+the knob is an argument and not only the LGBM_TPU_REC_ROUTING env),
+reports the HLO-cost-analysis FLOP ratio and wall-clock per routing,
+and writes the artifact to ``.bench/kernel_ab_routing.json``
+(atomic writer, PR 11 conventions).
+
+Histogram/e2e A/B (TPU; the original tool):  python tools/kernel_ab.py [rows]
 
 Times, at bench shapes (F=28, B=255, L=255):
   1. sorted level kernel, v1 vs bsub
@@ -20,7 +34,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-ROWS = int(float(sys.argv[1])) if len(sys.argv) > 1 else 1_000_000
+_ARGS = [a for a in sys.argv[1:] if not a.startswith("-")]
+_FLAGS = {a for a in sys.argv[1:] if a.startswith("-")}
+ROWS = int(float(_ARGS[0])) if _ARGS else 1_000_000
 
 
 def t(fn, reps=5):
@@ -33,6 +49,122 @@ def t(fn, reps=5):
         out = fn()
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / reps * 1000
+
+
+def routing_ab(rows):
+    """A/B the two partition-routing strategies in ONE process: bitwise
+    parity of partition_window and the fused split step, HLO FLOPs per
+    routing (cost analysis of the interpret lowering — the dots vs the
+    compress network as real XLA ops), and wall-clock per routing on
+    the current backend.  Writes .bench/kernel_ab_routing.json."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import record as R
+    from lightgbm_tpu.resilience import atomic_write_json
+
+    interpret = jax.default_backend() != "tpu"
+    T = R.TILE
+    F, B = 28, 255
+    k = R.bins_per_word(jnp.uint8)
+    n = R.round_up(min(rows, 262_144) if interpret else rows, T)
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, B, (F, n)).astype(np.uint8)
+    rec = R.build_record(
+        jnp.asarray(bins), jnp.asarray(rng.randn(n).astype(np.float32)),
+        jnp.ones(n, jnp.float32),
+        jnp.asarray((rng.rand(n) < 0.8).astype(np.float32)),  # bag word
+        n + T)
+    leaf_row = R.num_words(F, k) + 4
+    cap = n
+    fv = R.extract_feature(rec, jnp.int32(2), jnp.int32(0), cap, k)
+    go = (fv <= 100).astype(jnp.int32)
+    pcnt = jnp.int32(n - 37)  # ragged: invalid tail rides the window
+    args = (rec, go, jnp.int32(0), pcnt, jnp.bool_(True))
+    kw = dict(cap=cap, left_leaf=jnp.int32(0), right_leaf=jnp.int32(1),
+              leaf_row=leaf_row, interpret=interpret)
+
+    out = {"tool": "kernel_ab.routing_ab", "rows": int(n),
+           "tile": int(T), "backend": jax.default_backend(),
+           "default_routing": R.ROUTING,
+           "parity": {}, "flops": {}, "wall_ms": {}}
+
+    recs = {}
+    for routing in ("onehot", "prefix"):
+        r2, nl = R.partition_window(*args, routing=routing, **kw)
+        jax.block_until_ready(r2)
+        recs[routing] = (np.asarray(r2).tobytes(), int(nl))
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            r2, nl = R.partition_window(*args, routing=routing, **kw)
+        jax.block_until_ready(r2)
+        out["wall_ms"][routing] = round(
+            (time.perf_counter() - t0) / reps * 1000, 3)
+
+        def _flops(lowered):
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            return float(ca.get("flops", 0.0))
+
+        # whole-program FLOPs at the A/B window (context: the interpret
+        # grid is a while loop, so the kernel body counts ONCE and the
+        # surrounding O(n) work dilutes the ratio as n grows) ...
+        out["flops"].setdefault("program", {})[routing] = _flops(
+            R.partition_window.lower(
+                *args, routing=routing, **dict(kw, interpret=True)))
+        # ... and the ROUTING-KERNEL FLOPs at a one-TILE window (the
+        # hlo_audit pinned shape): the acceptance-criterion number —
+        # per-tile routing work is what the strategies differ in
+        out["flops"].setdefault("kernel_one_tile", {})[routing] = _flops(
+            R.partition_window.lower(
+                rec, go[:T], jnp.int32(0), jnp.int32(T),
+                jnp.bool_(True), routing=routing,
+                **dict(kw, cap=T, interpret=True)))
+    bitwise = (recs["onehot"][0] == recs["prefix"][0]
+               and recs["onehot"][1] == recs["prefix"][1])
+    out["parity"]["partition_window_bitwise"] = bitwise
+    for key in ("program", "kernel_one_tile"):
+        d = out["flops"][key]
+        d["onehot_over_prefix"] = round(
+            d["onehot"] / max(d["prefix"], 1.0), 2)
+
+    # fused split step: all four outputs must agree byte-for-byte
+    # (fresh inputs per routing — hists is donated)
+    from lightgbm_tpu.analysis.hlo_audit import _split_step_inputs
+
+    ss = {}
+    for routing in ("onehot", "prefix"):
+        srec, hists, scal_f, meta, s, scap, sk = _split_step_inputs()
+        o = R.split_step_window(
+            hists, srec, s["begin"], s["pcnt"], s["do_split"], s["f"],
+            s["thr"], s["is_cat"], s["parent_slot"], s["new_slot"],
+            scal_f, meta, F=4, cap=scap, k=sk, interpret=interpret,
+            routing=routing)
+        ss[routing] = b"".join(np.asarray(x).tobytes() for x in o)
+    out["parity"]["split_step_window_bitwise"] = ss["onehot"] == ss["prefix"]
+
+    print(f"routing A/B (n={n}, TILE={T}, backend="
+          f"{out['backend']}):", flush=True)
+    print(f"  partition_window bitwise-identical: "
+          f"{out['parity']['partition_window_bitwise']}", flush=True)
+    print(f"  split_step_window bitwise-identical: "
+          f"{out['parity']['split_step_window_bitwise']}", flush=True)
+    for key in ("kernel_one_tile", "program"):
+        d = out["flops"][key]
+        print(f"  HLO flops [{key}]: onehot {d['onehot']:.3e}, prefix "
+              f"{d['prefix']:.3e} ({d['onehot_over_prefix']}x)",
+              flush=True)
+    print(f"  wall ms/partition: {out['wall_ms']}", flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".bench", "kernel_ab_routing.json")
+    atomic_write_json(path, out)
+    print(f"  wrote {path}", flush=True)
+    assert bitwise and out["parity"]["split_step_window_bitwise"], (
+        "routing parity FAILED — do not ship")
+    return out
 
 
 def main():
@@ -65,6 +197,26 @@ def main():
         if not require_tpu_or_row(jax.default_backend()):
             return
     interpret = jax.default_backend() != "tpu"
+
+    # partition-routing A/B first: cheap, runs on any backend, and its
+    # parity assert is the thing that must never regress silently.
+    # Guarded like every other section — if Mosaic rejects the prefix
+    # kernel on a real chip (the documented risk; routing="prefix" is
+    # explicit here, so the LGBM_TPU_REC_ROUTING=onehot escape hatch
+    # cannot skip it), the histogram/e2e A/B below must still get its
+    # chip window.  --routing-only keeps the loud failure.
+    try:
+        routing_ab(ROWS)
+        routing_ok = True
+    except Exception as e:
+        print(f"routing A/B FAILED: {type(e).__name__}: {str(e)[:300]}",
+              flush=True)
+        routing_ok = False
+    if "--routing-only" in _FLAGS:
+        if not routing_ok:
+            sys.exit(1)
+        return
+
     rng = np.random.RandomState(0)
     F, B, L = 28, 255, 255
     bins = jnp.asarray(rng.randint(0, B, (F, ROWS)).astype(np.uint8))
