@@ -18,8 +18,6 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-import numpy as np
-
 from .config import (Config, key_alias_transform, parse_config_file,
                      parse_line_params)
 from .io.dataset import BinnedDataset
@@ -28,7 +26,8 @@ from .models.dart import create_boosting
 from .models.gbdt import GBDT
 from .obs import RunManifest, manifest_path, telemetry
 from .objectives import create_objective
-from .resilience import EXIT_PREEMPTED, atomic_writer
+from .resilience import EXIT_PREEMPTED
+from .serving.batch import DEFAULT_CHUNK_ROWS, DEFAULT_STREAM_THRESHOLD
 
 
 def load_parameters(argv: List[str]) -> Dict[str, str]:
@@ -54,10 +53,20 @@ def load_parameters(argv: List[str]) -> Dict[str, str]:
 class Predictor:
     """Batch file prediction -> result file (src/application/predictor.hpp:
     24-155): parse input rows, run normal/raw/leaf-index prediction,
-    write one line per row (tab-separated for multi-output)."""
+    write one line per row (tab-separated for multi-output).
 
-    # inputs above this size stream through parse_file_chunks
-    stream_threshold = 1 << 28  # 256MB
+    The heavy lifting lives in serving/batch.py: large CSV/TSV inputs
+    stream through an overlapped parse -> predict -> write pipeline
+    (reader thread prefetches the next chunk while the device runs the
+    current one; a writer thread formats/writes under the crash-safe
+    ``atomic_writer``).  ``overlap=False`` restores the old strictly
+    sequential behavior; both are byte-identical."""
+
+    # the single source of truth for both knobs is serving/batch.py;
+    # these are instance-overridable mirrors, not independent copies
+    stream_threshold = DEFAULT_STREAM_THRESHOLD
+    chunk_rows = DEFAULT_CHUNK_ROWS
+    overlap = True
 
     def __init__(self, booster, is_raw_score: bool, is_predict_leaf_index: bool):
         self.booster = booster
@@ -65,42 +74,29 @@ class Predictor:
         self.is_leaf = is_predict_leaf_index
 
     def predict_file(self, data_path: str, result_path: str, has_header: bool = False,
-                     num_iteration: int = -1) -> None:
-        # crash-safe streaming write (resilience/atomic.py): a failing
-        # or preempted predict must neither destroy an existing result
-        # file nor leave a truncated one under the real name
-        with atomic_writer(result_path) as fh:
-            for out in self._predict_chunks(
-                data_path, has_header, num_iteration
-            ):
-                out = np.asarray(out)
-                if out.ndim == 1:
-                    for v in out:
-                        fh.write(f"{v:.9g}\n")
-                else:
-                    for row in out:
-                        fh.write("\t".join(f"{v:.9g}" for v in row) + "\n")
+                     num_iteration: int = -1) -> dict:
+        from .serving.batch import pipelined_predict_file
+
+        return pipelined_predict_file(
+            self.booster, data_path, result_path, has_header=has_header,
+            num_iteration=num_iteration, raw_score=self.is_raw_score,
+            pred_leaf=self.is_leaf,
+            stream_threshold=self.stream_threshold,
+            chunk_rows=self.chunk_rows, overlap=self.overlap,
+        )
 
     def _predict_chunks(self, data_path, has_header, num_iteration):
-        """Stream large CSV/TSV predict inputs chunk by chunk (the
-        reference's Predictor also streams, predictor.hpp:82); small or
-        LibSVM inputs take the one-shot path."""
-        from .io.parser import detect_file_format, parse_file_chunks
+        """The parity seam (tests pin streamed == one-shot bytes):
+        prediction arrays chunk by chunk via the shared stream."""
+        from .serving.batch import predict_chunk_stream
 
-        fmt = detect_file_format(data_path, has_header)
-        big = os.path.getsize(data_path) > self.stream_threshold
-        kw = dict(num_iteration=num_iteration, raw_score=self.is_raw_score,
-                  pred_leaf=self.is_leaf)
-        if fmt == "libsvm" or not big:
-            yield self.booster.predict(data_path, data_has_header=has_header,
-                                       **kw)
-            return
-        label_idx = self.booster._gbdt.label_idx
-        max_feat = self.booster._gbdt.max_feature_idx
-        for chunk in parse_file_chunks(data_path, has_header, fmt):
-            if chunk.shape[1] > max_feat + 1:
-                chunk = np.delete(chunk, label_idx, axis=1)
-            yield self.booster.predict(chunk, **kw)
+        yield from predict_chunk_stream(
+            self.booster, data_path, has_header=has_header,
+            num_iteration=num_iteration, raw_score=self.is_raw_score,
+            pred_leaf=self.is_leaf,
+            stream_threshold=self.stream_threshold,
+            chunk_rows=self.chunk_rows,
+        )
 
 
 def _output_metrics(gbdt: GBDT, iter_num: int, names: List[str],
@@ -353,7 +349,7 @@ def run_predict(cfg: Config) -> None:
         Log.fatal("input_model should not be empty for prediction task")
     booster = Booster(model_file=cfg.input_model)
     t0 = time.perf_counter()
-    Predictor(
+    stats = Predictor(
         booster, cfg.is_predict_raw_score, cfg.is_predict_leaf_index
     ).predict_file(
         cfg.data, cfg.output_result, cfg.has_header,
@@ -363,6 +359,20 @@ def run_predict(cfg: Config) -> None:
         f"Finish prediction, use {time.perf_counter() - t0:.6f} seconds; "
         f"saved to {cfg.output_result}"
     )
+    if cfg.verbose >= 2:
+        Log.debug("predict pipeline " + json.dumps(stats, sort_keys=True))
+
+
+def run_serve(cfg: Config) -> None:
+    """``task=serve``: the online micro-batched inference service
+    (serving/server.py; docs/serving.md) — a persistent on-device
+    ensemble behind shape-bucketed dispatch with checksum-verified
+    hot-swap, serving until SIGINT/SIGTERM."""
+    from .serving import serve_from_config
+
+    if not cfg.input_model:
+        Log.fatal("input_model should not be empty for serve task")
+    serve_from_config(cfg, block=True)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -386,6 +396,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             run_train(cfg)
         elif cfg.task in ("predict", "prediction", "test"):
             run_predict(cfg)
+        elif cfg.task == "serve":
+            run_serve(cfg)
         else:
             Log.fatal(f"Unknown task: {cfg.task!r}")
     except TrainingPreempted as ex:

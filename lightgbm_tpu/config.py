@@ -285,6 +285,21 @@ class Config:
     # LGBM_TPU_COLLECTIVE_DEADLINE_S overrides
     collective_deadline_s: float = 0.0
 
+    # ---- online serving (task=serve; docs/serving.md)
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 9090  # 0 = ephemeral (tests)
+    # largest coalesced dispatch; also the top padded-shape bucket
+    serve_max_batch_rows: int = 1024
+    # micro-batch coalescing window: the oldest pending request never
+    # waits longer than this before its batch dispatches
+    serve_max_delay_ms: float = 2.0
+    # explicit bucket ladder ("8 16 64 256"); empty = powers of two up
+    # to serve_max_batch_rows
+    serve_buckets: str = ""
+    # require a .sha256 sidecar on the model loaded at serve startup
+    # (hot-swap candidates ALWAYS require one; see docs/serving.md)
+    serve_require_checksum: bool = False
+
     def __post_init__(self):
         if not self.metric:
             self.metric = []
@@ -396,6 +411,12 @@ class Config:
             raise ValueError("snapshot_freq must be >= 0")
         if self.collective_deadline_s < 0:
             raise ValueError("collective_deadline_s must be >= 0")
+        if not 0 <= self.serve_port <= 65535:
+            raise ValueError("serve_port must be in [0, 65535]")
+        if self.serve_max_batch_rows < 1:
+            raise ValueError("serve_max_batch_rows must be >= 1")
+        if self.serve_max_delay_ms < 0:
+            raise ValueError("serve_max_delay_ms must be >= 0")
         if not 0.0 <= self.skip_drop <= 1.0:
             raise ValueError("skip_drop must be in [0, 1]")
 

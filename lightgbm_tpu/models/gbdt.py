@@ -65,6 +65,25 @@ def _use_matmul_predict() -> bool:
     return _PREDICT_MM != "0"
 
 
+def raw_score_output(out: np.ndarray, num_class: int) -> np.ndarray:
+    """[K, n] raw scores -> the public raw-score shape ([n] or [n, K])."""
+    return out[0] if num_class == 1 else out.T
+
+
+def transform_scores(out: np.ndarray, num_class: int, sigmoid: float,
+                     objective_name: str) -> np.ndarray:
+    """GBDT::Predict's host-side f64 output transform (gbdt.cpp:
+    631-645), factored out so the serving engine applies bitwise the
+    SAME transform as the offline predictor (serving/engine.py)."""
+    if sigmoid > 0 and num_class == 1 and objective_name == "binary":
+        return 1.0 / (1.0 + np.exp(-2.0 * sigmoid * out[0]))
+    if num_class > 1:
+        z = out - out.max(axis=0, keepdims=True)
+        e = np.exp(z)
+        return (e / e.sum(axis=0, keepdims=True)).T
+    return out[0]
+
+
 @functools.partial(jax.jit, donate_argnums=(1,))
 @phase_scope("leaf-update")
 def _post_grow_step(tree, scores, k, leaf_id, rate, bounds_mat, real_feat):
@@ -855,19 +874,14 @@ class GBDT:
         return np.asarray(acc, np.float64)
 
     def predict_raw_score(self, X, num_iteration: int = -1) -> np.ndarray:
-        out = self._raw_scores(X, num_iteration)
-        return out[0] if self.num_class == 1 else out.T
+        return raw_score_output(self._raw_scores(X, num_iteration),
+                                self.num_class)
 
     def predict(self, X, num_iteration: int = -1) -> np.ndarray:
         """With transform (GBDT::Predict, gbdt.cpp:631-645)."""
-        out = self._raw_scores(X, num_iteration)
-        if self.sigmoid > 0 and self.num_class == 1 and self.objective_name() == "binary":
-            return 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * out[0]))
-        if self.num_class > 1:
-            z = out - out.max(axis=0, keepdims=True)
-            e = np.exp(z)
-            return (e / e.sum(axis=0, keepdims=True)).T
-        return out[0]
+        return transform_scores(self._raw_scores(X, num_iteration),
+                                self.num_class, self.sigmoid,
+                                self.objective_name())
 
     def predict_leaf_index(self, X, num_iteration: int = -1) -> np.ndarray:
         K = self.num_class

@@ -20,6 +20,10 @@ spec                        injection point
                             ``UNAVAILABLE`` — exercises retry_transient
 ``fail_write_once``         first atomic_write fails before its rename —
                             the destination must stay intact
+``corrupt_model``           every serving hot-swap candidate is corrupted
+                            mid-file before verification
+                            (serving/hotswap.py) — the swap must be
+                            refused and the old model keeps answering
 ==========================  ====================================================
 
 The env var is read once at import (the repo-wide convention for
@@ -36,7 +40,7 @@ import signal
 from typing import Dict, Optional
 
 _VALID = ("kill_after_tree", "corrupt_checkpoint", "nan_grads",
-          "fail_collective_once", "fail_write_once")
+          "fail_collective_once", "fail_write_once", "corrupt_model")
 
 
 class InjectedFault(Exception):
@@ -131,20 +135,36 @@ def maybe_fail_collective() -> None:
             "UNAVAILABLE: injected transient collective failure")
 
 
-def maybe_corrupt_checkpoint(path: str) -> bool:
-    """Checkpoint-writer hook: overwrite bytes in the middle of the
-    freshly committed file with ASCII filler.  ASCII (not bit-flips) so
-    the JSON usually stays *parseable* and the corruption is caught by
-    the content CHECKSUM — the deepest validation layer; when the filler
-    happens to break the JSON structure instead, the shallower
-    unreadable-file error path is exercised.  Either way the resume must
-    refuse loudly.  Returns True when corruption was injected."""
-    if fault_active("corrupt_checkpoint") is None:
-        return False
+def _overwrite_mid_file(path: str) -> None:
+    """Overwrite bytes in the middle of ``path`` with ASCII filler.
+    ASCII (not bit-flips) so a text format usually stays *parseable*
+    and the corruption is caught by the content CHECKSUM — the deepest
+    validation layer; when the filler happens to break the structure
+    instead, the shallower unreadable-file error path is exercised."""
     size = os.path.getsize(path)
     with open(path, "r+b") as fh:
         fh.seek(size // 2)
         fh.write(b"A" * min(16, max(1, size // 2)))
+
+
+def maybe_corrupt_checkpoint(path: str) -> bool:
+    """Checkpoint-writer hook: corrupt the freshly committed file —
+    either way the resume must refuse loudly.  Returns True when
+    corruption was injected."""
+    if fault_active("corrupt_checkpoint") is None:
+        return False
+    _overwrite_mid_file(path)
+    return True
+
+
+def maybe_corrupt_model(path: str) -> bool:
+    """serving/hotswap.py hook, fired BEFORE sidecar verification:
+    corrupt the hot-swap candidate model file so the checksum check is
+    what refuses it (the lab analog of a truncated/partial model write
+    reaching a serving replica).  Returns True when injected."""
+    if fault_active("corrupt_model") is None or not os.path.exists(path):
+        return False
+    _overwrite_mid_file(path)
     return True
 
 

@@ -1,0 +1,358 @@
+"""Persistent on-device ensemble behind padded-shape bucketing.
+
+The matmul predictor (ops/predict_matmul.py) made per-row compute
+trivial; what was missing for "millions of users" (ROADMAP item 4) is a
+*serving* shape discipline: online traffic arrives as a stream of
+small, arbitrarily-sized batches, and a jit cache keyed on shapes would
+recompile on every new batch size — the exact failure mode the jaxlint
+``jit-cache-miss-risk`` rule exists to prevent.
+
+:class:`ServingEngine` closes that hole by construction:
+
+* **Packed residency** — the stacked tree pytree and path-incidence
+  tables (:class:`PackedModel`) are built once per model and stay
+  resident on device; a request dispatches against them without any
+  per-request host->device model traffic.
+* **Padded-shape bucketing** — requests are zero-padded up to a fixed
+  set of power-of-two row buckets, so every dispatch in steady state
+  hits one of ``len(buckets)`` compiled programs.  Pad rows are sliced
+  off the result; per-row outputs are bitwise-independent of the pad
+  (every op in the matmul predictor is row-wise — pinned by
+  tests/test_serving.py).
+* **Pre-warmed buckets** — :meth:`ServingEngine.prewarm` runs one
+  dispatch per bucket at startup (and per hot-swap candidate, off the
+  serving path), so steady state is recompile-free *by construction*;
+  the ``backend_compiles`` counter (analysis/recompile.py) pins it in
+  tier-1 rather than as a bench claim.
+* **Donated input buffers** — on TPU the padded input buffer is donated
+  to the dispatch, so the transfer buffer is reused instead of held
+  alive across the program (donation is skipped on CPU, where XLA
+  cannot use it and warns).
+
+Output transform parity: the engine applies the SAME host-side f64
+sigmoid/softmax as ``GBDT.predict`` (shared ``transform_scores``), and
+the walk/matmul per-tree outputs are bitwise-identical (pinned by
+tests/test_predict_matmul.py) — so a served response is bitwise the
+response the offline predictor would have given.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..log import Log
+from ..obs import telemetry
+
+DEFAULT_MAX_BATCH_ROWS = 1024
+DEFAULT_MIN_BUCKET = 8
+
+
+def _raw_bucket_scores(tables, stacked, X):
+    """[K, bucket] f32 raw scores for one padded bucket dispatch."""
+    from ..ops.predict_matmul import ensemble_sum_matmul
+
+    return ensemble_sum_matmul(tables, stacked, X)
+
+
+# one process-wide jitted dispatcher shared by every engine: the jit
+# cache then keys on (model tensor shapes, bucket) only — two engines
+# serving the same model shape share compiled programs.  Built lazily
+# so importing this module never initializes a jax backend (the
+# donation decision needs jax.default_backend()).
+_DISPATCH = None
+_DISPATCH_LOCK = threading.Lock()
+
+
+def _bucket_dispatch():
+    global _DISPATCH
+    if _DISPATCH is None:
+        with _DISPATCH_LOCK:
+            if _DISPATCH is None:
+                # donate the padded input buffer on TPU (serving's
+                # steady-state HBM win); CPU XLA ignores donation and
+                # warns, so skip it there
+                donate = (2,) if jax.default_backend() == "tpu" else ()
+                _DISPATCH = jax.jit(_raw_bucket_scores,
+                                    donate_argnums=donate)
+    return _DISPATCH
+
+
+def power_of_two_buckets(max_rows: int,
+                         min_bucket: int = DEFAULT_MIN_BUCKET) -> List[int]:
+    """The default bucket ladder: powers of two from ``min_bucket`` up
+    to (and including) the smallest power covering ``max_rows``."""
+    if max_rows < 1:
+        raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+    buckets = []
+    b = max(1, int(min_bucket))
+    while b < max_rows:
+        buckets.append(b)
+        b *= 2
+    buckets.append(b)
+    return buckets
+
+
+class PackedModel:
+    """One model's device-resident serving tensors plus its identity.
+
+    ``model_id`` is the sha256 content digest of the model artifact —
+    for file-loaded models this is the SAME digest the ``.sha256``
+    sidecar carries (hotswap.py verifies it), so a response's
+    ``model_id`` is end-to-end checkable provenance.
+    """
+
+    __slots__ = ("model_id", "source", "stacked", "tables", "num_trees",
+                 "num_class", "num_features", "sigmoid", "objective",
+                 "warmed_buckets")
+
+    def __init__(self, model_id: str, source: str, stacked, tables,
+                 num_trees: int, num_class: int, num_features: int,
+                 sigmoid: float, objective: str) -> None:
+        self.model_id = model_id
+        self.source = source
+        self.stacked = stacked
+        self.tables = tables
+        self.num_trees = num_trees
+        self.num_class = num_class
+        self.num_features = num_features
+        self.sigmoid = sigmoid
+        self.objective = objective
+        self.warmed_buckets: set = set()
+
+    @classmethod
+    def from_gbdt(cls, gbdt, source: str = "<memory>",
+                  model_id: Optional[str] = None) -> "PackedModel":
+        """Pack a GBDT's full ensemble (leading axes [n_iter, K], the
+        grouped layout ``ensemble_sum_matmul`` consumes)."""
+        n_trees = len(gbdt.models)
+        if n_trees == 0:
+            raise ValueError("cannot serve a model with zero trees")
+        if gbdt.max_feature_idx < 0:
+            raise ValueError("model carries no feature count "
+                             "(max_feature_idx < 0)")
+        if model_id is None:
+            import hashlib
+
+            model_id = hashlib.sha256(
+                gbdt.save_model_to_string(-1).encode()).hexdigest()
+        stacked = gbdt._stacked_models(n_trees, grouped=True)
+        tables = gbdt._stacked_tables(n_trees, grouped=True)
+        return cls(
+            model_id=model_id, source=source, stacked=stacked,
+            tables=tables, num_trees=n_trees, num_class=gbdt.num_class,
+            num_features=gbdt.max_feature_idx + 1,
+            sigmoid=float(gbdt.sigmoid),
+            objective=gbdt.objective_name(),
+        )
+
+    def transform(self, raw: np.ndarray) -> np.ndarray:
+        """The offline predictor's output transform, bit-for-bit
+        (models/gbdt.py transform_scores): [K, n] f64 raw -> final."""
+        from ..models.gbdt import transform_scores
+
+        return transform_scores(raw, self.num_class, self.sigmoid,
+                                self.objective)
+
+    def describe(self) -> dict:
+        return {
+            "model_id": self.model_id,
+            "source": self.source,
+            "num_trees": self.num_trees,
+            "num_class": self.num_class,
+            "num_features": self.num_features,
+            "objective": self.objective,
+        }
+
+
+class ServingEngine:
+    """A persistent compiled ensemble behind shape-bucketed dispatch.
+
+    ``model`` may be a :class:`PackedModel`, a ``GBDT``, a
+    ``basic.Booster``, or a model-file path (routed through
+    hotswap.load_packed_model, which checksum-verifies a sidecar when
+    present).  The engine pre-warms every bucket at construction unless
+    ``warm=False``.
+
+    Thread safety: :meth:`predict_with_meta` reads ``self._active``
+    exactly once, so a whole request is served by ONE model even while
+    :meth:`swap` flips the active ensemble concurrently — the hot-swap
+    atomicity contract (docs/serving.md).
+    """
+
+    def __init__(self, model, buckets: Optional[Sequence[int]] = None,
+                 max_batch_rows: int = DEFAULT_MAX_BATCH_ROWS,
+                 warm: bool = True,
+                 require_checksum: bool = True) -> None:
+        pm = self._coerce_model(model, require_checksum)
+        if buckets is None:
+            buckets = power_of_two_buckets(max_batch_rows)
+        buckets = sorted({int(b) for b in buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"invalid bucket set {buckets!r}")
+        self.buckets: Tuple[int, ...] = tuple(buckets)
+        self.max_batch_rows = self.buckets[-1]
+        self._swap_lock = threading.Lock()
+        self._active = pm
+        if warm:
+            self.prewarm()
+
+    @staticmethod
+    def _coerce_model(model, require_checksum: bool) -> PackedModel:
+        if isinstance(model, PackedModel):
+            return model
+        if isinstance(model, str):
+            from .hotswap import load_packed_model
+
+            return load_packed_model(model,
+                                     require_checksum=require_checksum)
+        if hasattr(model, "_gbdt"):  # basic.Booster
+            return PackedModel.from_gbdt(model._gbdt)
+        if hasattr(model, "models"):  # GBDT
+            return PackedModel.from_gbdt(model)
+        raise TypeError(
+            f"cannot build a ServingEngine from {type(model).__name__}; "
+            "pass a model file path, PackedModel, GBDT, or Booster")
+
+    # ------------------------------------------------------------ shape
+    @property
+    def active(self) -> PackedModel:
+        return self._active
+
+    @property
+    def model_id(self) -> str:
+        return self._active.model_id
+
+    @property
+    def num_features(self) -> int:
+        return self._active.num_features
+
+    @property
+    def num_class(self) -> int:
+        return self._active.num_class
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket covering ``n`` rows (callers chunk anything
+        above the largest bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    # ---------------------------------------------------------- dispatch
+    def _dispatch_rows(self, pm: PackedModel, Xc: np.ndarray) -> np.ndarray:
+        """One bucketed device dispatch: pad -> run -> slice.  Returns
+        [K, n] float64 raw scores (the same f32->f64 materialization
+        point as GBDT._raw_scores, for bitwise transform parity)."""
+        n = Xc.shape[0]
+        b = self.bucket_for(n)
+        Xp = np.zeros((b, pm.num_features), np.float32)
+        Xp[:n] = Xc
+        out = _bucket_dispatch()(pm.tables, pm.stacked, jnp.asarray(Xp))
+        telemetry.count("serving.dispatches")
+        telemetry.record_value("serving.batch_occupancy", n / b)
+        return np.asarray(out, np.float64)[:, :n]
+
+    def predict_with_meta(self, X, raw_score: bool = False
+                          ) -> Tuple[np.ndarray, str]:
+        """Serve one (possibly coalesced) batch; returns
+        ``(values, model_id)``.  ``values`` is [n] for single-output
+        models, [n, K] for multiclass — row-sliceable either way, which
+        is what the micro-batch queue's scatter relies on."""
+        pm = self._active  # ONE read: the whole request serves one model
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"expected [n, F] request rows, got shape "
+                             f"{X.shape}")
+        if X.shape[1] != pm.num_features:
+            raise ValueError(
+                f"request has {X.shape[1]} features, model "
+                f"{pm.model_id[:12]} expects {pm.num_features}")
+        parts = []
+        for lo in range(0, X.shape[0], self.max_batch_rows):
+            # per-chunk materialization IS the product (same contract as
+            # GBDT._raw_scores' chunk loop)
+            parts.append(self._dispatch_rows(pm, X[lo:lo + self.max_batch_rows]))  # jaxlint: disable=host-sync-in-loop
+        raw = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+        if raw_score:
+            from ..models.gbdt import raw_score_output
+
+            return raw_score_output(raw, pm.num_class), pm.model_id
+        return pm.transform(raw), pm.model_id
+
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        vals, _ = self.predict_with_meta(X, raw_score=raw_score)
+        return vals
+
+    # ------------------------------------------------------------ warmup
+    def prewarm(self, pm: Optional[PackedModel] = None) -> dict:
+        """Dispatch one zero batch per bucket against ``pm`` (default:
+        the active model) so every steady-state shape is compiled OFF
+        the request path.  Returns ``{buckets, compiles, seconds}``;
+        the compile count feeds the recompile-free tier-1 gate."""
+        from ..analysis.recompile import compile_counter
+
+        pm = self._active if pm is None else pm
+        cc = compile_counter()
+        t0 = time.perf_counter()
+        for b in self.buckets:
+            Xz = jnp.asarray(np.zeros((b, pm.num_features), np.float32))
+            out = _bucket_dispatch()(pm.tables, pm.stacked, Xz)
+            out.block_until_ready()
+            pm.warmed_buckets.add(b)
+        compiles = cc.delta()
+        seconds = time.perf_counter() - t0
+        telemetry.count("serving.warm_compiles", compiles)
+        Log.info(
+            f"serving: warmed {len(self.buckets)} bucket(s) "
+            f"{list(self.buckets)} for model {pm.model_id[:12]} in "
+            f"{seconds:.3f}s ({compiles} compiles)")
+        return {"buckets": list(self.buckets), "compiles": compiles,
+                "seconds": round(seconds, 3)}
+
+    # -------------------------------------------------------------- swap
+    def swap(self, new_pm: PackedModel) -> str:
+        """Atomically flip the active ensemble; returns the OLD
+        model_id.  Requests that already read ``self._active`` finish
+        on the old model; every later request serves the new one.
+        Callers wanting the full verified hot-swap contract (checksum,
+        off-path prewarm, loud refusal) use hotswap.adopt_model."""
+        if not isinstance(new_pm, PackedModel):
+            raise TypeError("swap() takes a PackedModel; use "
+                            "hotswap.adopt_model for a model file")
+        old = self._active
+        if new_pm.num_features != old.num_features:
+            raise ValueError(
+                f"refusing swap: candidate expects {new_pm.num_features} "
+                f"features, serving model expects {old.num_features} — "
+                "clients would crash mid-flight")
+        if new_pm.num_class != old.num_class:
+            raise ValueError(
+                f"refusing swap: candidate has num_class="
+                f"{new_pm.num_class}, serving model has "
+                f"{old.num_class} — response shape would change")
+        with self._swap_lock:
+            self._active = new_pm
+        telemetry.count("serving.swaps")
+        Log.info(
+            f"serving: hot-swapped {old.model_id[:12]} "
+            f"({old.num_trees} trees) -> {new_pm.model_id[:12]} "
+            f"({new_pm.num_trees} trees)")
+        return old.model_id
+
+    def describe(self) -> dict:
+        pm = self._active
+        return {
+            **pm.describe(),
+            "buckets": list(self.buckets),
+            "max_batch_rows": self.max_batch_rows,
+            "warmed_buckets": sorted(pm.warmed_buckets),
+        }

@@ -1,0 +1,301 @@
+"""Stdlib HTTP/JSON serving front end + in-process client.
+
+A deliberately dependency-free transport over the real serving stack
+(engine + micro-batch queue + hot-swap).  One shared set of API
+handlers backs both the HTTP server and :class:`InProcessClient`, so
+tier-1 tests exercise exactly the request/response contract the wire
+speaks without paying socket overhead, and one HTTP smoke test covers
+the transport itself.
+
+Endpoints (JSON in/out):
+
+=======================  ====================================================
+``POST /v1/predict``     ``{"rows": [[...], ...], "raw_score": false}`` ->
+                         ``{"predictions": [...], "model_id": ..., "n": N}``
+``POST /v1/swap``        ``{"model": "/path/to/model.txt"}`` -> swap summary;
+                         409 + error on a corrupt/unverifiable candidate
+                         (the old model keeps serving)
+``GET  /v1/healthz``     engine identity + bucket set + queue depth
+``GET  /v1/stats``       full telemetry snapshot (serving reservoirs incl.
+                         request p50/p99, batch occupancy, queue depth)
+=======================  ====================================================
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..log import Log
+from ..obs import RunManifest, telemetry
+from ..resilience.atomic import ArtifactCorrupt
+from .engine import ServingEngine
+from .queue import MicroBatchQueue
+
+_PREDICT_TIMEOUT_S = 120.0
+
+
+# ------------------------------------------------------------- handlers
+def api_predict(engine: ServingEngine, queue: MicroBatchQueue,
+                payload: dict) -> Tuple[int, dict]:
+    rows = payload.get("rows")
+    if rows is None:
+        return 400, {"error": "missing 'rows'"}
+    try:
+        X = np.asarray(rows, dtype=np.float64)
+    except (TypeError, ValueError) as e:
+        return 400, {"error": f"rows not numeric: {e}"}
+    raw = bool(payload.get("raw_score", False))
+    if raw != queue._raw_score:
+        # the queue batches homogeneous work; per-request raw_score
+        # would force per-request dispatch — serve it engine-direct,
+        # but feed the SAME traffic counters/reservoir the queue path
+        # feeds, or /v1/stats and the serving manifest undercount load
+        t0 = time.perf_counter()
+        try:
+            vals, model_id = engine.predict_with_meta(X, raw_score=raw)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        n = int(np.asarray(vals).shape[0])
+        telemetry.count("serving.requests")
+        telemetry.count("serving.rows", n)
+        telemetry.record_value("serving.request_s",
+                               time.perf_counter() - t0)
+        return 200, {"predictions": np.asarray(vals).tolist(),
+                     "model_id": model_id, "n": n}
+    try:
+        res = queue.predict(X, timeout=_PREDICT_TIMEOUT_S)
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    return 200, {"predictions": np.asarray(res.values).tolist(),
+                 "model_id": res.model_id,
+                 "n": int(np.asarray(res.values).shape[0])}
+
+
+def api_swap(engine: ServingEngine, payload: dict,
+             require_checksum: bool = True) -> Tuple[int, dict]:
+    path = payload.get("model")
+    if not path:
+        return 400, {"error": "missing 'model' (path to the candidate)"}
+    from .hotswap import adopt_model
+
+    try:
+        summary = adopt_model(engine, str(path),
+                              require_checksum=require_checksum)
+    except (ArtifactCorrupt, ValueError) as e:
+        # refused: the old model keeps serving — 409 Conflict carries
+        # the actionable reason
+        return 409, {"error": str(e), "model_id": engine.model_id}
+    return 200, summary
+
+
+def api_health(engine: ServingEngine,
+               queue: MicroBatchQueue) -> Tuple[int, dict]:
+    return 200, {"status": "ok", "queue_depth": queue.depth,
+                 **engine.describe()}
+
+
+def api_stats() -> Tuple[int, dict]:
+    return 200, {"telemetry": telemetry.get_telemetry().snapshot()}
+
+
+class InProcessClient:
+    """The tier-1 client: same handlers, no sockets.  Every method
+    returns ``(status_code, payload_dict)`` exactly as the HTTP
+    transport would."""
+
+    def __init__(self, engine: ServingEngine, queue: MicroBatchQueue,
+                 require_checksum: bool = True) -> None:
+        self.engine = engine
+        self.queue = queue
+        self.require_checksum = require_checksum
+
+    def predict(self, rows, raw_score: bool = False) -> Tuple[int, dict]:
+        return api_predict(self.engine, self.queue,
+                           {"rows": rows, "raw_score": raw_score})
+
+    def swap(self, model_path: str) -> Tuple[int, dict]:
+        return api_swap(self.engine, {"model": model_path},
+                        require_checksum=self.require_checksum)
+
+    def health(self) -> Tuple[int, dict]:
+        return api_health(self.engine, self.queue)
+
+    def stats(self) -> Tuple[int, dict]:
+        return api_stats()
+
+
+# -------------------------------------------------------------- server
+class _ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # the handler reaches these through self.server
+    engine: ServingEngine
+    queue: MicroBatchQueue
+    require_checksum: bool
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt: str, *args) -> None:
+        Log.debug("serve: " + fmt % args)
+
+    def _send(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        try:
+            if self.path == "/v1/healthz":
+                self._send(*api_health(self.server.engine,
+                                       self.server.queue))
+            elif self.path == "/v1/stats":
+                self._send(*api_stats())
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+        except BrokenPipeError:  # client went away mid-response
+            pass
+        except Exception as e:  # noqa: BLE001 — a probe must see 500, not a reset
+            telemetry.count("serving.http_errors")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad JSON body: {e}"})
+            return
+        try:
+            if self.path == "/v1/predict":
+                self._send(*api_predict(self.server.engine,
+                                        self.server.queue, payload))
+            elif self.path == "/v1/swap":
+                self._send(*api_swap(
+                    self.server.engine, payload,
+                    require_checksum=self.server.require_checksum))
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+        except BrokenPipeError:  # client went away mid-response
+            pass
+        except Exception as e:  # noqa: BLE001 — a request must never kill the server
+            telemetry.count("serving.http_errors")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class ServingServer:
+    """The HTTP front end bound to an engine + queue.  ``port=0`` binds
+    an ephemeral port (tests); ``.url`` reports the bound address."""
+
+    def __init__(self, engine: ServingEngine, queue: MicroBatchQueue,
+                 host: str = "127.0.0.1", port: int = 0,
+                 require_checksum: bool = True) -> None:
+        self.engine = engine
+        self.queue = queue
+        self.httpd = _ServingHTTPServer((host, port), _Handler)
+        self.httpd.engine = engine
+        self.httpd.queue = queue
+        self.httpd.require_checksum = require_checksum
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        """Serve on a background thread (tests / embedding)."""
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="lgbm-serve-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Blocking serve (the CLI path)."""
+        self.httpd.serve_forever()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(10)
+        self.queue.close()
+
+
+def write_serving_manifest(engine: ServingEngine, path: str,
+                           result: Optional[dict] = None) -> str:
+    """A serving RunManifest: engine identity + the serving telemetry
+    snapshot, with per-request p50/p99 from ``serving.request_s``."""
+    manifest = RunManifest.collect(
+        "serving", config=None,
+        result={**engine.describe(), **(result or {})},
+        per_tree_reservoir="serving.request_s",
+    )
+    return manifest.write(path)
+
+
+def serve_from_config(cfg, block: bool = True) -> Optional[ServingServer]:
+    """``task=serve`` entry (cli.py): build the serving stack from a
+    Config and run it.  ``block=False`` returns the started server (the
+    tier-1 path); ``block=True`` serves until SIGINT/SIGTERM, then
+    writes the serving manifest next to the model."""
+    if not cfg.input_model:
+        raise ValueError("input_model should not be empty for serve task")
+    from .hotswap import load_packed_model
+
+    pm = load_packed_model(cfg.input_model,
+                           require_checksum=cfg.serve_require_checksum)
+    buckets = None
+    if cfg.serve_buckets:
+        buckets = [int(x) for x in
+                   str(cfg.serve_buckets).replace(",", " ").split()]
+    engine = ServingEngine(pm, buckets=buckets,
+                           max_batch_rows=cfg.serve_max_batch_rows)
+    queue = MicroBatchQueue(engine,
+                            max_delay_s=cfg.serve_max_delay_ms / 1000.0)
+    server = ServingServer(engine, queue, host=cfg.serve_host,
+                           port=cfg.serve_port)
+    Log.info(
+        f"serving model {engine.model_id[:12]} ({pm.num_trees} trees) "
+        f"at {server.url} — buckets {list(engine.buckets)}, "
+        f"max_delay {cfg.serve_max_delay_ms}ms")
+    if not block:
+        return server.start()
+
+    import signal
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):  # noqa: ARG001
+        Log.info("serving: shutdown signal received, draining")
+        stop.set()
+
+    old_term = signal.signal(signal.SIGTERM, _stop)
+    old_int = signal.signal(signal.SIGINT, _stop)
+    server.start()
+    try:
+        stop.wait()
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+        server.close()
+        try:
+            mpath = cfg.input_model + ".serving.manifest.json"
+            write_serving_manifest(engine, mpath)
+            Log.info(f"Wrote serving manifest to {mpath}")
+        except Exception as e:  # noqa: BLE001 — best-effort evidence
+            Log.warning(f"serving manifest write failed: {e}")
+    return None

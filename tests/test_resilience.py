@@ -501,7 +501,8 @@ def test_chaos_dryrun_smoke():
     summary = json.loads(r.stdout.strip().splitlines()[-1])
     assert summary["failures"] == 0
     assert set(summary["results"]) == {
-        "kill_resume", "corrupt", "fail_write", "nan_grads", "collective"}
+        "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
+        "serve_swap", "serve_fail_write"}
 
 
 @pytest.mark.slow

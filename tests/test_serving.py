@@ -1,0 +1,643 @@
+"""Tier-1 gate for the serving subsystem (lightgbm_tpu/serving/).
+
+Pins the acceptance criteria of the serving PR as *tests*, not bench
+claims:
+
+* steady-state serving is recompile-free: a mixed stream of >= 1000
+  requests across >= 4 batch sizes leaves ``backend_compiles`` flat
+  after bucket warm-up;
+* a served response is bitwise the offline predictor's answer (engine
+  vs ``Booster.predict``), independent of padding bucket and request
+  coalescing;
+* hot-swap under load is atomic and safe: pre-flip responses match the
+  old model bitwise, post-flip the new model, no errors during the
+  swap, and a corrupt candidate (``corrupt_model`` fault) is refused
+  while the old model keeps serving;
+* the streamed batch tier is byte-identical to the one-shot path and
+  honors ``num_iteration_predict`` identically on both (the kw is
+  built once — the pin for the audited plumbing);
+* serving bench artifacts are benchdiff-gateable like training ones.
+
+The multi-minute soak/load shape lives behind the ``slow`` marker
+(tools/bench_serving.py is the driver).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from lightgbm_tpu.basic import Booster  # noqa: E402
+from lightgbm_tpu.cli import Predictor, main  # noqa: E402
+from lightgbm_tpu.resilience import faults  # noqa: E402
+from lightgbm_tpu.resilience.atomic import ArtifactCorrupt  # noqa: E402
+from lightgbm_tpu.serving import (InProcessClient, MicroBatchQueue,  # noqa: E402
+                                  ServingEngine, adopt_model,
+                                  load_packed_model, power_of_two_buckets)
+
+N_FEAT = 6
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Two models (B = A + 4 continued-training rounds), their data,
+    and a shared warm engine+queue for the read-only tests."""
+    tmp = tmp_path_factory.mktemp("serving")
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, N_FEAT)
+    y = (X[:, 0] + 0.3 * rng.randn(400) > 0).astype(np.float64)
+    data = str(tmp / "d.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    m_a, m_b = str(tmp / "a.txt"), str(tmp / "b.txt")
+    base = ["task=train", f"data={data}", "objective=binary",
+            "num_leaves=7", "min_data_in_leaf=5",
+            "is_save_binary_file=false", "verbose=-1"]
+    assert main(base + ["num_trees=6", f"output_model={m_a}"]) == 0
+    assert main(base + ["num_trees=4", f"input_model={m_a}",
+                        f"output_model={m_b}"]) == 0
+    return {"tmp": tmp, "data": data, "model_a": m_a, "model_b": m_b,
+            "booster_a": Booster(model_file=m_a),
+            "booster_b": Booster(model_file=m_b)}
+
+
+@pytest.fixture()
+def engine_a(served):
+    """A fresh engine on model A per test (swap tests mutate it)."""
+    return ServingEngine(served["model_a"], buckets=(8, 32, 128),
+                        max_batch_rows=128)
+
+
+# ------------------------------------------------------------ engine
+def test_bucket_ladder():
+    assert power_of_two_buckets(1024) == [8, 16, 32, 64, 128, 256, 512,
+                                          1024]
+    assert power_of_two_buckets(100) == [8, 16, 32, 64, 128]
+    with pytest.raises(ValueError):
+        power_of_two_buckets(0)
+
+
+def test_engine_bitwise_parity_with_offline_predictor(served, engine_a):
+    """A served response IS the offline answer: engine (matmul path,
+    padded buckets) vs Booster.predict, bitwise, at several request
+    sizes — including sizes that pad into different buckets."""
+    rng = np.random.RandomState(1)
+    for n in (1, 7, 8, 20, 100, 200):  # 200 > max bucket: row-chunked
+        Xq = rng.randn(n, N_FEAT)
+        exp = served["booster_a"].predict(Xq)
+        got, mid = engine_a.predict_with_meta(Xq)
+        assert got.tobytes() == exp.tobytes(), f"mismatch at n={n}"
+        assert mid == engine_a.model_id
+    # raw scores too
+    Xq = rng.randn(16, N_FEAT)
+    exp = served["booster_a"].predict(Xq, raw_score=True)
+    got = engine_a.predict(Xq, raw_score=True)
+    assert got.tobytes() == exp.tobytes()
+
+
+def test_engine_rejects_bad_requests(engine_a):
+    with pytest.raises(ValueError):
+        engine_a.predict(np.zeros((0, N_FEAT)))
+    with pytest.raises(ValueError):
+        engine_a.predict(np.zeros((4, N_FEAT + 2)))
+
+
+def test_engine_requires_checksum_by_default(served, tmp_path):
+    bare = str(tmp_path / "bare.txt")
+    shutil.copy(served["model_a"], bare)  # no sidecar
+    with pytest.raises(ArtifactCorrupt, match="sidecar"):
+        load_packed_model(bare)
+    pm = load_packed_model(bare, require_checksum=False)
+    assert pm.num_trees == 6
+
+
+# ------------------------------------------------------------- queue
+def test_queue_scatters_coalesced_batches(served, engine_a):
+    """Concurrent small submits coalesce into shared dispatches and the
+    scattered slices are bitwise the per-request answers."""
+    rng = np.random.RandomState(2)
+    Xq = rng.randn(60, N_FEAT)
+    exp = served["booster_a"].predict(Xq)
+    with MicroBatchQueue(engine_a, max_delay_s=0.005) as q:
+        futs = [q.submit(Xq[lo:lo + 5]) for lo in range(0, 60, 5)]
+        out = [f.result(30) for f in futs]
+    cat = np.concatenate([r.values for r in out])
+    assert cat.tobytes() == exp.tobytes()
+    from lightgbm_tpu.obs import telemetry
+
+    tel = telemetry.get_telemetry()
+    assert tel.counter("serving.requests") >= 12
+    assert tel.reservoir("serving.request_s") is not None
+
+
+def test_queue_single_request_latency_bounded(engine_a):
+    """A lone request never waits out more than ~one delay window."""
+    with MicroBatchQueue(engine_a, max_delay_s=0.01) as q:
+        t0 = time.perf_counter()
+        res = q.predict(np.zeros((1, N_FEAT)), timeout=10)
+        wall = time.perf_counter() - t0
+    assert res.values.shape == (1,)
+    assert wall < 2.0  # generous CI bound; policy bound is ~10ms
+
+
+def test_queue_failed_batch_fails_only_its_futures(served, engine_a):
+    """A poisoned request fails its future; the dispatcher survives and
+    keeps serving later requests."""
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        # feature-width validation happens at submit: bad rows rejected
+        with pytest.raises(ValueError):
+            q.submit(np.zeros((2, N_FEAT + 1)))
+        ok = q.predict(np.zeros((2, N_FEAT)), timeout=30)
+        assert ok.values.shape == (2,)
+
+
+def test_queue_closed_rejects_submits(engine_a):
+    q = MicroBatchQueue(engine_a, max_delay_s=0.001)
+    q.close()
+    with pytest.raises(RuntimeError):
+        q.submit(np.zeros((1, N_FEAT)))
+
+
+def test_queue_cancelled_future_does_not_kill_dispatcher(engine_a):
+    """A client that times out and cancel()s its still-pending future
+    must fail only its own request: set_result on a cancelled future
+    raises InvalidStateError, and that must not escape the dispatcher
+    thread (the 'dispatcher never dies' contract)."""
+    with MicroBatchQueue(engine_a, max_delay_s=0.2) as q:
+        doomed = q.submit(np.zeros((1, N_FEAT)))
+        live = q.submit(np.ones((2, N_FEAT)))
+        assert doomed.cancel(), "future dispatched before cancel(); " \
+            "the 0.2s coalescing window should have held it pending"
+        assert live.result(30).values.shape == (2,)
+        # the dispatcher survived the cancelled sibling: a fresh
+        # request still round-trips
+        assert q.predict(np.zeros((3, N_FEAT)),
+                         timeout=30).values.shape == (3,)
+
+
+# ------------------------------------- acceptance: recompile-free steady
+def test_steady_state_recompile_free_1000_mixed_requests(served, engine_a):
+    """ISSUE acceptance verbatim: after bucket warm-up, >= 1000 requests
+    across >= 4 batch sizes leave backend_compiles FLAT."""
+    from lightgbm_tpu.analysis.recompile import compile_counter
+
+    rng = np.random.RandomState(3)
+    pool = rng.randn(512, N_FEAT)
+    sizes = [1, 5, 17, 64]  # 4 sizes -> buckets 8/8/32/64..128 mixed
+    with MicroBatchQueue(engine_a, max_delay_s=0.0005) as q:
+        for n in sizes:  # one mixed warm pass (engine buckets are
+            q.predict(pool[:n], timeout=30)  # already prewarmed)
+        cc = compile_counter()
+        futs = [q.submit(pool[(i * 7) % 400:(i * 7) % 400 + sizes[i % 4]])
+                for i in range(1000)]
+        results = [f.result(60) for f in futs]
+    assert len(results) == 1000
+    assert cc.delta() == 0, (
+        f"{cc.delta()} backend compiles during steady-state serving — "
+        "bucketing failed to keep the jit cache closed")
+    # spot-check correctness rode along
+    exp = served["booster_a"].predict(pool[:5])
+    got = engine_a.predict(pool[:5])
+    assert got.tobytes() == exp.tobytes()
+
+
+# ------------------------------------------- acceptance: hot-swap safety
+def test_hotswap_under_load_bitwise_and_safe(served, engine_a):
+    """Responses before the flip match the OLD model bitwise, after the
+    flip the NEW model; no request errors during the swap; per-client
+    model transitions are monotonic (no A-B-A mixing)."""
+    rng = np.random.RandomState(4)
+    Xq = rng.randn(8, N_FEAT)
+    exp_a = served["booster_a"].predict(Xq)
+    exp_b = served["booster_b"].predict(Xq)
+    assert exp_a.tobytes() != exp_b.tobytes()  # the flip is observable
+    id_a = engine_a.model_id
+
+    stop = threading.Event()
+    n_clients = 4
+    per_client = [[] for _ in range(n_clients)]
+    errors = []
+    total = [0]
+
+    def client(idx):
+        mine = per_client[idx]
+        with MicroBatchQueue(engine_a, max_delay_s=0.0005) as q:
+            while not stop.is_set():
+                try:
+                    r = q.predict(Xq, timeout=30)
+                except Exception as e:  # noqa: BLE001 — recorded, asserted empty
+                    errors.append(e)
+                    return
+                mine.append((r.model_id, r.values.tobytes()))
+                total[0] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    while total[0] < 50:  # old model under load
+        time.sleep(0.002)
+    summary = adopt_model(engine_a, served["model_b"])
+    n_at_swap = total[0]
+    while total[0] < n_at_swap + 100:  # new model under load
+        time.sleep(0.002)
+    stop.set()
+    for t in threads:
+        t.join(30)
+
+    assert not errors, f"request errors during swap: {errors[:3]}"
+    assert summary["old_model_id"] == id_a
+    id_b = summary["new_model_id"]
+    records = [rec for mine in per_client for rec in mine]
+    seen = {mid for mid, _ in records}
+    assert seen == {id_a, id_b}, f"unexpected model ids {seen}"
+    for mid, blob in records:
+        if mid == id_a:
+            assert blob == exp_a.tobytes(), "pre-flip response != old model"
+        else:
+            assert blob == exp_b.tobytes(), "post-flip response != new model"
+    # the flip is one reference assignment, so each CLIENT (whose next
+    # request only dispatches after its previous result) never sees the
+    # old model again once the new one has answered it.  (Monotonicity
+    # across clients is not a property of any real service: two
+    # clients' dispatches straddling the flip complete in arbitrary
+    # thread order.)
+    for idx, mine in enumerate(per_client):
+        flipped = False
+        for mid, _ in mine:
+            if mid == id_b:
+                flipped = True
+            elif flipped:
+                pytest.fail(
+                    f"client {idx}: old-model response AFTER a "
+                    "new-model response — the swap was not atomic in "
+                    "this client's dispatch order")
+    assert any(mid == id_b for mid, _ in records)
+
+
+def test_hotswap_corrupt_candidate_refused_old_keeps_serving(
+        served, engine_a, tmp_path):
+    """ISSUE acceptance: a corrupt candidate is refused (checksum, via
+    the corrupt_model fault) and the old model keeps serving."""
+    rng = np.random.RandomState(5)
+    Xq = rng.randn(12, N_FEAT)
+    exp_a = served["booster_a"].predict(Xq)
+    cand = str(tmp_path / "cand.txt")
+    shutil.copy(served["model_b"], cand)
+    shutil.copy(served["model_b"] + ".sha256", cand + ".sha256")
+    id_before = engine_a.model_id
+    faults.set_fault("corrupt_model")
+    try:
+        with pytest.raises(ArtifactCorrupt, match="sha256|checksum"):
+            adopt_model(engine_a, cand)
+    finally:
+        faults.clear_faults()
+    assert engine_a.model_id == id_before
+    assert engine_a.predict(Xq).tobytes() == exp_a.tobytes()
+    from lightgbm_tpu.obs import telemetry
+
+    assert telemetry.get_telemetry().counter("serving.swap_refused") >= 1
+
+
+def test_swap_incompatible_shape_refused(served, engine_a, tmp_path):
+    """A candidate with a different feature count would crash clients
+    mid-flight: refused with an actionable error."""
+    rng = np.random.RandomState(6)
+    X = rng.randn(300, N_FEAT + 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    data = str(tmp_path / "wide.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    wide = str(tmp_path / "wide.txt")
+    assert main(["task=train", f"data={data}", "objective=binary",
+                 "num_trees=2", "num_leaves=5", "min_data_in_leaf=5",
+                 f"output_model={wide}", "is_save_binary_file=false",
+                 "verbose=-1"]) == 0
+    with pytest.raises(ValueError, match="features"):
+        adopt_model(engine_a, wide)
+
+
+# ---------------------------------------------------- server transport
+def test_http_server_and_inprocess_client(served, engine_a, tmp_path):
+    """One smoke over the wire (ephemeral port), everything else via
+    the shared handlers the InProcessClient exposes."""
+    import http.client
+
+    from lightgbm_tpu.serving import ServingServer
+
+    rng = np.random.RandomState(7)
+    Xq = rng.randn(5, N_FEAT)
+    exp = served["booster_a"].predict(Xq)
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        client = InProcessClient(engine_a, q)
+        code, out = client.predict(Xq.tolist())
+        assert code == 200
+        assert np.asarray(out["predictions"]).tobytes() == exp.tobytes()
+        assert out["model_id"] == engine_a.model_id
+        code, out = client.predict([[1, 2]])  # wrong width
+        assert code == 400 and "error" in out
+        code, out = client.health()
+        assert code == 200 and out["status"] == "ok"
+        assert out["buckets"] == [8, 32, 128]
+        code, out = client.stats()
+        assert code == 200 and "telemetry" in out
+
+        server = ServingServer(engine_a, q, port=0).start()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=30)
+            body = json.dumps({"rows": Xq.tolist()})
+            conn.request("POST", "/v1/predict", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            wire = json.loads(resp.read())
+            assert resp.status == 200
+            assert np.asarray(wire["predictions"]).tobytes() == exp.tobytes()
+            conn.request("GET", "/v1/healthz", None, {})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "ok"
+            # corrupt swap over the wire: 409, old model keeps serving
+            cand = str(tmp_path / "wire_cand.txt")
+            shutil.copy(served["model_b"], cand)
+            shutil.copy(served["model_b"] + ".sha256", cand + ".sha256")
+            faults.set_fault("corrupt_model")
+            try:
+                conn.request("POST", "/v1/swap",
+                             json.dumps({"model": cand}),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 409
+                assert "error" in json.loads(resp.read())
+            finally:
+                faults.clear_faults()
+            conn.request("GET", "/v1/healthz", None, {})
+            resp = conn.getresponse()
+            assert json.loads(resp.read())["model_id"] == engine_a.model_id
+            conn.close()
+        finally:
+            server.httpd.shutdown()
+            server.httpd.server_close()
+
+
+def test_serve_from_config_nonblocking(served):
+    """task=serve wiring: a Config builds the whole stack; block=False
+    returns a live server (the tier-1 path the CLI shares)."""
+    import http.client
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving import serve_from_config
+
+    cfg = Config(task="serve", input_model=served["model_a"],
+                 serve_port=0, serve_buckets="8 32",
+                 serve_max_batch_rows=32)
+    server = serve_from_config(cfg, block=False)
+    try:
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=30)
+        conn.request("GET", "/v1/healthz", None, {})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200
+        assert out["num_trees"] == 6
+        assert out["buckets"] == [8, 32]
+        conn.close()
+    finally:
+        server.close()
+
+
+# --------------------------------------- batch tier (satellite parity)
+def test_streamed_predict_file_byte_identical_to_oneshot(served, tmp_path):
+    """Satellite: chunked _predict_chunks / pipelined predict_file
+    output must be byte-identical to the one-shot path on the same
+    file — with overlap on AND off."""
+    rng = np.random.RandomState(8)
+    Xb = rng.randn(3000, N_FEAT)
+    big = str(tmp_path / "big.csv")
+    np.savetxt(big, np.column_stack([np.zeros(3000), Xb]), fmt="%.6g",
+               delimiter=",")
+    p = Predictor(served["booster_a"], False, False)
+    r1, r2, r3 = (str(tmp_path / f"r{i}.txt") for i in (1, 2, 3))
+    p.predict_file(big, r1)  # one-shot (default 256MB threshold)
+    p.stream_threshold = 1
+    p.chunk_rows = 577  # ragged multi-chunk
+    s2 = p.predict_file(big, r2)  # streamed, overlapped
+    p.overlap = False
+    s3 = p.predict_file(big, r3)  # streamed, sequential
+    b1 = open(r1, "rb").read()
+    assert b1 == open(r2, "rb").read(), "pipelined bytes != one-shot"
+    assert b1 == open(r3, "rb").read(), "sequential-streamed != one-shot"
+    assert s2["chunks"] == s3["chunks"] == 6
+    assert s2["streamed"] and s2["overlap"] and not s3["overlap"]
+    # the chunk generator seam (kept for parity consumers) agrees too:
+    # streamed chunks concatenated == the one-shot array, bitwise
+    cat = np.concatenate(list(p._predict_chunks(big, False, -1)))
+    p2 = Predictor(served["booster_a"], False, False)  # default threshold
+    one = np.concatenate(list(p2._predict_chunks(big, False, -1)))
+    assert cat.tobytes() == one.tobytes()
+
+
+def test_num_iteration_honored_identically_streamed_and_oneshot(
+        served, tmp_path):
+    """Satellite pin: num_iteration_predict reaches every chunk's
+    predict call identically on both paths (the kw is built once)."""
+    rng = np.random.RandomState(9)
+    Xb = rng.randn(800, N_FEAT)
+    f = str(tmp_path / "ni.csv")
+    np.savetxt(f, np.column_stack([np.zeros(800), Xb]), fmt="%.6g",
+               delimiter=",")
+    p = Predictor(served["booster_a"], False, False)
+    one_full, one_k, st_k = (str(tmp_path / n) for n in
+                             ("of.txt", "ok.txt", "sk.txt"))
+    p.predict_file(f, one_full, num_iteration=-1)
+    p.predict_file(f, one_k, num_iteration=3)
+    p.stream_threshold = 1
+    p.chunk_rows = 131
+    p.predict_file(f, st_k, num_iteration=3)
+    bk = open(one_k, "rb").read()
+    assert bk == open(st_k, "rb").read(), (
+        "num_iteration=3 differs between streamed and one-shot paths")
+    assert bk != open(one_full, "rb").read(), (
+        "num_iteration=3 output equals the full model — the limit was "
+        "silently ignored")
+    # direct engine parity with the truncated model: first 3 iterations
+    exp = served["booster_a"].predict(Xb[:10], num_iteration=3)
+    got = np.loadtxt(st_k)[:10]
+    np.testing.assert_allclose(got, exp, rtol=1e-8)
+
+
+def test_batch_pipeline_overlaps_parse_with_predict(served, tmp_path):
+    """The overlap mechanics, independent of host core count: with a
+    predict stage that waits on the 'device' (GIL released — a sleep,
+    exactly what a TPU dispatch wait looks like to the host), the
+    pipelined wall approaches max(parse, predict) while the sequential
+    wall pays parse + predict.  On the single-core CI container the
+    REAL stages compete for one core, so this stub is the honest way to
+    pin that the reader thread actually prefetches."""
+
+    class _DeviceWaitBooster:
+        """Wraps the real booster; every chunk predict 'runs on device'
+        for a fixed wall time (time.sleep releases the GIL)."""
+
+        def __init__(self, inner, wait_s):
+            self._gbdt = inner._gbdt
+            self._inner = inner
+            self._wait = wait_s
+
+        def predict(self, data, **kw):
+            out = self._inner.predict(data, **kw)
+            time.sleep(self._wait)
+            return out
+
+    rng = np.random.RandomState(10)
+    big = str(tmp_path / "ov.csv")
+    np.savetxt(big, np.column_stack(
+        [np.zeros(4000), rng.randn(4000, N_FEAT)]), fmt="%.6g",
+        delimiter=",")
+    from lightgbm_tpu.serving.batch import pipelined_predict_file
+
+    stub = _DeviceWaitBooster(served["booster_a"], wait_s=0.03)
+    kw = dict(has_header=False, stream_threshold=1, chunk_rows=400)
+    r_seq, r_pipe = str(tmp_path / "ov_s.txt"), str(tmp_path / "ov_p.txt")
+    s_seq = pipelined_predict_file(stub, big, r_seq, overlap=False, **kw)
+    s_pipe = pipelined_predict_file(stub, big, r_pipe, overlap=True, **kw)
+    assert open(r_seq, "rb").read() == open(r_pipe, "rb").read()
+    assert s_seq["chunks"] == s_pipe["chunks"] == 10
+    # 10 chunks x 30ms device wait: sequential pays parse on top of the
+    # waits; pipelined hides parse inside them.  Require a real margin
+    # (not noise): at least 2 chunk-waits' worth of overlap.
+    assert s_pipe["wall_s"] < s_seq["wall_s"] - 0.06, (
+        f"pipeline failed to overlap: sequential {s_seq['wall_s']}s, "
+        f"pipelined {s_pipe['wall_s']}s")
+
+
+def test_batch_pipeline_abort_releases_reader(served, tmp_path):
+    """A mid-stream predict failure must not strand the prefetch
+    reader on the bounded parse queue — it holds the input file and up
+    to `prefetch` parsed chunks alive for the life of the process."""
+
+    class _PoisonedBooster:
+        def __init__(self, inner):
+            self._gbdt = inner._gbdt
+            self._inner = inner
+            self.calls = 0
+
+        def predict(self, data, **kw):
+            self.calls += 1
+            if self.calls >= 2:
+                raise RuntimeError("poisoned chunk")
+            return self._inner.predict(data, **kw)
+
+    rng = np.random.RandomState(5)
+    data = str(tmp_path / "poison.csv")
+    np.savetxt(data, np.column_stack(
+        [np.zeros(600), rng.randn(600, N_FEAT)]), fmt="%.6g",
+        delimiter=",")
+    result = str(tmp_path / "res.txt")
+    from lightgbm_tpu.serving.batch import pipelined_predict_file
+
+    with pytest.raises(RuntimeError, match="poisoned"):
+        pipelined_predict_file(_PoisonedBooster(served["booster_a"]),
+                               data, result, stream_threshold=1,
+                               chunk_rows=50)
+    leftover = [t.name for t in threading.enumerate()
+                if t.name.startswith("lgbm-batch") and t.is_alive()]
+    assert not leftover, f"pipeline threads leaked: {leftover}"
+    assert not os.path.exists(result)  # atomic: no partial result
+
+
+# ------------------------------------------------- benchdiff (satellite)
+def _serving_artifact(p50, p99, rps, err, mode="online"):
+    s = {"mode": mode, "p50_ms": p50, "p99_ms": p99,
+         "throughput_rps": rps, "error_rate": err, "requests": 1000}
+    if mode == "batch":
+        s["file_to_file_s"] = p50
+    return {"schema": "lightgbm-tpu/serving-bench/v1", "serving": s,
+            "shape": {"clients": 8}}
+
+
+def _benchdiff(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "benchdiff.py"),
+         *argv],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+
+
+def test_benchdiff_gates_serving_artifacts(tmp_path):
+    """Satellite: serving perf is gate-able like training perf — +20%
+    p50 and a fresh error rate are REGRESSIONs; the reverse is clean;
+    serving vs training artifacts exit 2."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_serving_artifact(2.0, 6.0, 900.0, 0.0)))
+    new.write_text(json.dumps(_serving_artifact(2.4, 6.1, 880.0, 0.01)))
+    r = _benchdiff(str(old), str(new))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+    assert "error_rate" in r.stdout
+
+    r = _benchdiff(str(new), str(old))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # p99-only blow-up: phase-threshold discipline
+    new.write_text(json.dumps(_serving_artifact(2.0, 9.0, 900.0, 0.0)))
+    r = _benchdiff(str(old), str(new))
+    assert r.returncode == 1 and "p99_ms" in r.stdout
+
+    # serving vs training: not comparable, usage error
+    train = tmp_path / "train.json"
+    train.write_text(json.dumps({"metric": "m", "value": 0.4}))
+    r = _benchdiff(str(old), str(train))
+    assert r.returncode == 2
+    assert "not comparable" in r.stderr
+
+
+def test_benchdiff_gates_batch_artifacts(tmp_path):
+    old = tmp_path / "ob.json"
+    new = tmp_path / "nb.json"
+    old.write_text(json.dumps(_serving_artifact(10.0, 0, 0, 0.0,
+                                                mode="batch")))
+    new.write_text(json.dumps(_serving_artifact(12.5, 0, 0, 0.0,
+                                                mode="batch")))
+    r = _benchdiff(str(old), str(new))
+    assert r.returncode == 1 and "file-to-file" in r.stdout
+    # online vs batch serving artifacts: modes differ -> usage error
+    onl = tmp_path / "on.json"
+    onl.write_text(json.dumps(_serving_artifact(2.0, 6.0, 900.0, 0.0)))
+    r = _benchdiff(str(onl), str(new))
+    assert r.returncode == 2
+
+
+# ------------------------------------------------------------ soak (slow)
+@pytest.mark.slow
+def test_serving_soak_load_generator(tmp_path):
+    """The heavy-traffic shape end-to-end: thousands of concurrent
+    1-64-row requests through the real load generator, with a hot-swap
+    under load, plus the batch tier — all gates enforced by the tool's
+    own exit code (errors, steady compiles, pipeline speedup)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_serving.py"),
+         "--requests", "2000", "--clients", "32", "--swap",
+         "--batch-rows", "60000", "--train-rows", "5000",
+         "--trees", "16", "--out-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=1200, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    online = json.loads((tmp_path / "serving_online.json").read_text())
+    assert online["serving"]["errors"] == 0
+    assert online["serving"]["compiles_steady"] == 0
+    assert online["serving"]["swap"]["new_model_id"] != \
+        online["serving"]["swap"]["old_model_id"]
+    batch = json.loads((tmp_path / "serving_batch.json").read_text())
+    assert batch["serving"]["byte_identical"]
+    # single-core CI caps the overlap win at parity; the never-slower
+    # gate is the tool's own; the overlap MECHANICS are pinned by
+    # test_batch_pipeline_overlaps_parse_with_predict
+    assert batch["serving"]["speedup"] >= 0.9

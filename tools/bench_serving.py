@@ -1,0 +1,386 @@
+#!/usr/bin/env python
+"""Serving load generator: p50/p99/throughput at heavy-traffic shapes.
+
+Two tiers, each committing a ``.bench/serving_*.json`` artifact (schema
+``lightgbm-tpu/serving-bench/v1``) plus a RunManifest sibling, both
+diffable by ``tools/benchdiff.py``:
+
+* **online** — N client threads fire thousands of concurrent 1-64-row
+  requests into the micro-batched serving stack (engine + queue);
+  optionally performs a checksum-verified hot-swap at the halfway mark
+  (``--swap``) to prove adoption under load at bench scale.  Reports
+  per-request p50/p99/mean latency, request+row throughput, error rate,
+  batch occupancy, and the steady-state compile count (must be 0 —
+  the recompile-free-by-construction claim, measured, not asserted).
+* **batch** (``--batch-rows N``) — file-to-file prediction of an
+  N-row CSV through the OLD strictly-sequential path and the overlapped
+  parse->predict->write pipeline (serving/batch.py), byte-comparing the
+  outputs and reporting the speedup.
+
+Usage:
+    python tools/bench_serving.py                      # online, default shape
+    python tools/bench_serving.py --requests 4000 --clients 64 --swap
+    python tools/bench_serving.py --batch-rows 200000
+    python tools/bench_serving.py --model m.txt --out-dir .bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
+
+
+def log(msg: str) -> None:
+    print(f"[bench_serving] {msg}", file=sys.stderr, flush=True)
+
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def train_model(tmp: str, rows: int, features: int, trees: int,
+                leaves: int, seed: int, extra=(),
+                name: str = "model") -> str:
+    """Self-contained synthetic model so the bench needs no inputs."""
+    import numpy as np
+
+    from lightgbm_tpu.cli import main as cli_main
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(rows, features)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(rows) > 0)
+    data = os.path.join(tmp, f"train_{name}_{seed}.csv")
+    np.savetxt(data, np.column_stack([y.astype(np.float64), X]),
+               fmt="%.6g", delimiter=",")
+    model = os.path.join(tmp, f"{name}_{seed}.txt")
+    rc = cli_main(["task=train", f"data={data}", "objective=binary",
+                   f"num_trees={trees}", f"num_leaves={leaves}",
+                   "min_data_in_leaf=20", "is_save_binary_file=false",
+                   f"output_model={model}", "verbose=-1", *extra])
+    assert rc == 0, f"bench model training failed rc={rc}"
+    return model
+
+
+# ------------------------------------------------------------- online tier
+def bench_online(args, model: str, model2: str) -> dict:
+    import numpy as np
+
+    from lightgbm_tpu.analysis.recompile import compile_counter
+    from lightgbm_tpu.obs import telemetry
+    from lightgbm_tpu.serving import (MicroBatchQueue, ServingEngine,
+                                      adopt_model)
+
+    engine = ServingEngine(model, max_batch_rows=args.max_batch_rows)
+    nf = engine.num_features
+    queue = MicroBatchQueue(engine, max_delay_s=args.max_delay_ms / 1000.0)
+    pool = np.random.RandomState(args.seed).randn(8192, nf)
+
+    per_client = args.requests // args.clients
+    total = per_client * args.clients
+    lat: list = []
+    errors = [0]
+    lat_lock = threading.Lock()
+    # fire the swap a third of the way in: on a loaded single-core host
+    # the adopt itself takes a while, and the point is requests landing
+    # on BOTH sides of the flip
+    swap_at = total // 3 if args.swap else -1
+    done_count = [0]
+    swap_gate = threading.Event()
+    if not args.swap:
+        swap_gate.set()
+    swap_info: dict = {}
+
+    def client(idx: int) -> None:
+        rng = np.random.RandomState(args.seed + 1 + idx)
+        my_lat = []
+        for _ in range(per_client):
+            n = rng.randint(args.rows_min, args.rows_max + 1)
+            lo = rng.randint(0, len(pool) - n)
+            try:
+                res = queue.predict(pool[lo:lo + n], timeout=120.0)
+                my_lat.append(res.latency_s)
+            except Exception:
+                with lat_lock:
+                    errors[0] += 1
+            with lat_lock:
+                done_count[0] += 1
+                if swap_at >= 0 and done_count[0] >= swap_at:
+                    swap_gate.set()
+        with lat_lock:
+            lat.extend(my_lat)
+
+    cc_steady = compile_counter()  # after warmup: steady state starts now
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    for t in threads:
+        t.start()
+    compiles_swap = 0
+    if args.swap:
+        swap_gate.wait()
+        at_start = done_count[0]
+        ts = time.perf_counter()
+        cc_swap = compile_counter()
+        swap_info = adopt_model(engine, model2)
+        # ALL adopt-time compiles (packing the new tree shapes + bucket
+        # prewarm) happen off the request path — exclude them from the
+        # steady-state count they would otherwise pollute
+        compiles_swap = cc_swap.delta()
+        swap_info["at_request"] = at_start
+        swap_info["done_when_flipped"] = done_count[0]
+        swap_info["swap_wall_s"] = round(time.perf_counter() - ts, 4)
+        swap_info["compiles_total"] = compiles_swap
+        log(f"hot-swapped under load at request ~{at_start} "
+            f"(flip landed at ~{swap_info['done_when_flipped']})")
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    queue.close()
+
+    compiles_total = cc_steady.delta()
+    lat.sort()
+    n_ok = len(lat)
+    tel = telemetry.get_telemetry()
+    batch_res = tel.reservoir("serving.batch_rows")
+    occ_res = tel.reservoir("serving.batch_occupancy")
+    result = {
+        "mode": "online",
+        "requests": total,
+        "completed": n_ok,
+        "errors": errors[0],
+        "error_rate": round(errors[0] / max(total, 1), 6),
+        "wall_s": round(wall, 4),
+        "throughput_rps": round(n_ok / wall, 1),
+        "rows_per_s": round(float(tel.counter("serving.rows")) / wall, 1),
+        "p50_ms": round(_percentile(lat, 50) * 1e3, 4),
+        "p99_ms": round(_percentile(lat, 99) * 1e3, 4),
+        "mean_ms": round(sum(lat) / max(n_ok, 1) * 1e3, 4),
+        "max_ms": round((lat[-1] if lat else 0.0) * 1e3, 4),
+        "batches": int(tel.counter("serving.batches")),
+        "mean_batch_rows": (round(batch_res.as_dict()["mean_s"], 2)
+                            if batch_res else None),
+        "mean_batch_occupancy": (round(occ_res.as_dict()["mean_s"], 4)
+                                 if occ_res else None),
+        "compiles_steady": compiles_total - compiles_swap,
+        "compiles_swap_prewarm": compiles_swap,
+        "swap": swap_info or None,
+    }
+    log(f"online: {n_ok}/{total} ok in {wall:.2f}s — "
+        f"p50 {result['p50_ms']}ms p99 {result['p99_ms']}ms "
+        f"{result['throughput_rps']} req/s, "
+        f"steady compiles {result['compiles_steady']}")
+    return result
+
+
+# -------------------------------------------------------------- batch tier
+def bench_batch(args, model: str, tmp: str) -> dict:
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.cli import Predictor
+
+    rng = np.random.RandomState(args.seed + 99)
+    booster = Booster(model_file=model)
+    nf = booster._gbdt.max_feature_idx + 1
+    data = os.path.join(tmp, "batch_in.csv")
+    log(f"batch: writing {args.batch_rows} x {nf} bench CSV")
+    block = rng.randn(min(args.batch_rows, 65536), nf)
+    with open(data, "w") as fh:  # scratch input, not an artifact
+        written = 0
+        while written < args.batch_rows:
+            take = min(len(block), args.batch_rows - written)
+            np.savetxt(fh, np.column_stack(
+                [np.zeros(take), block[:take]]), fmt="%.6g", delimiter=",")
+            written += take
+
+    p = Predictor(booster, False, False)
+    p.stream_threshold = 1  # force the streamed path for both runs
+    p.chunk_rows = args.batch_chunk_rows
+    out_seq = os.path.join(tmp, "out_seq.txt")
+    out_pipe = os.path.join(tmp, "out_pipe.txt")
+
+    p.overlap = True  # warm compile caches off the clock
+    p.predict_file(data, out_pipe)
+
+    # interleaved A/B, MEDIAN of N reps: the stages are CPU-heavy and
+    # the machine may be shared, so single runs carry multi-percent
+    # noise; every rep is recorded in the artifact so a reader can see
+    # the spread instead of trusting a point estimate
+    seq_reps, pipe_reps = [], []
+    stats_pipe: dict = {}
+    for _ in range(max(1, args.batch_reps)):
+        p.overlap = False
+        t0 = time.perf_counter()
+        p.predict_file(data, out_seq)
+        seq_reps.append(round(time.perf_counter() - t0, 4))
+        p.overlap = True
+        t0 = time.perf_counter()
+        stats_pipe = p.predict_file(data, out_pipe)
+        pipe_reps.append(round(time.perf_counter() - t0, 4))
+    seq_s = sorted(seq_reps)[len(seq_reps) // 2]
+    pipe_s = sorted(pipe_reps)[len(pipe_reps) // 2]
+
+    same = open(out_seq, "rb").read() == open(out_pipe, "rb").read()
+    assert same, "pipelined output is NOT byte-identical to sequential"
+    cores = os.cpu_count() or 1
+    result = {
+        "mode": "batch",
+        "rows": args.batch_rows,
+        "features": nf,
+        "chunk_rows": args.batch_chunk_rows,
+        "chunks": stats_pipe["chunks"],
+        "cpu_count": cores,
+        "file_to_file_s": pipe_s,
+        "unpipelined_s": seq_s,
+        "speedup": round(seq_s / pipe_s, 3),
+        "reps_unpipelined_s": seq_reps,
+        "reps_pipelined_s": pipe_reps,
+        "parse_wait_s": stats_pipe["parse_wait_s"],
+        "byte_identical": same,
+    }
+    log(f"batch: sequential {seq_s:.2f}s -> pipelined {pipe_s:.2f}s "
+        f"(median of {len(seq_reps)}; {result['speedup']}x) on {cores} "
+        "core(s), outputs byte-identical")
+    if cores == 1:
+        log("NOTE: single-core host — parse/predict/write compete for "
+            "the same core, so the overlap win is structurally capped "
+            "at ~1.0x here; the pipeline's gain needs the device (or a "
+            "second core) running predict while the host parses "
+            "(docs/serving.md).  tests/test_serving.py pins the overlap "
+            "mechanics independently of core count.")
+    return result
+
+
+# ------------------------------------------------------------------- main
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="",
+                    help="serve this model file (default: train a "
+                         "synthetic one)")
+    ap.add_argument("--out-dir", default=os.path.join(ROOT, ".bench"))
+    ap.add_argument("--tag", default="",
+                    help="artifact name suffix (serving_online_<tag>.json)")
+    ap.add_argument("--clients", type=int, default=48)
+    ap.add_argument("--requests", type=int, default=3000)
+    ap.add_argument("--rows-min", type=int, default=1)
+    ap.add_argument("--rows-max", type=int, default=64)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch-rows", type=int, default=1024)
+    ap.add_argument("--swap", action="store_true",
+                    help="hot-swap to a continued-training model at the "
+                         "halfway mark, under load")
+    ap.add_argument("--batch-rows", type=int, default=0,
+                    help="also run the batch tier at this row count")
+    ap.add_argument("--batch-chunk-rows", type=int, default=20000)
+    ap.add_argument("--batch-reps", type=int, default=3,
+                    help="best-of-N A/B repetitions for the batch tier")
+    ap.add_argument("--train-rows", type=int, default=20000)
+    ap.add_argument("--features", type=int, default=20)
+    ap.add_argument("--trees", type=int, default=32)
+    ap.add_argument("--leaves", type=int, default=31)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--online", dest="online", action="store_true",
+                    default=None, help="force the online tier on")
+    ap.add_argument("--no-online", dest="online", action="store_false")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+    from lightgbm_tpu.serving import write_serving_manifest
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_serving_")
+    os.makedirs(args.out_dir, exist_ok=True)
+    run_online = (args.online if args.online is not None
+                  else args.batch_rows == 0)
+
+    model = args.model or train_model(
+        tmp, args.train_rows, args.features, args.trees, args.leaves,
+        args.seed)
+    suffix = f"_{args.tag}" if args.tag else ""
+    shape = {"clients": args.clients, "requests": args.requests,
+             "rows_min": args.rows_min, "rows_max": args.rows_max,
+             "max_delay_ms": args.max_delay_ms,
+             "max_batch_rows": args.max_batch_rows,
+             "trees": args.trees, "leaves": args.leaves,
+             "features": args.features, "seed": args.seed}
+
+    rc = 0
+    if run_online:
+        model2 = ""
+        if args.swap:
+            # the new boosting round: continued training from the model
+            model2 = train_model(
+                tmp, args.train_rows, args.features, 8, args.leaves,
+                args.seed, extra=[f"input_model={model}"],
+                name="model_swapped")
+        serving = bench_online(args, model, model2)
+        if args.swap:
+            assert serving["swap"]["new_model_id"] != \
+                serving["swap"]["old_model_id"], "identity swap — bug"
+        from lightgbm_tpu.serving.engine import ServingEngine  # for manifest
+
+        artifact = {
+            "schema": SERVING_SCHEMA,
+            "created_unix": round(time.time(), 3),
+            "serving": serving,
+            "shape": shape,
+        }
+        out = os.path.join(args.out_dir, f"serving_online{suffix}.json")
+        atomic_write_json(out, artifact)
+        eng = ServingEngine(model, max_batch_rows=8, warm=False,
+                            require_checksum=False)
+        write_serving_manifest(
+            eng, out.replace(".json", ".manifest.json"), result=serving)
+        log(f"wrote {out}")
+        if serving["compiles_steady"] > 0:
+            log("FAIL: steady-state serving recompiled")
+            rc = 1
+        if serving["errors"]:
+            log(f"FAIL: {serving['errors']} request errors")
+            rc = 1
+
+    if args.batch_rows > 0:
+        batch = bench_batch(args, model, tmp)
+        artifact = {
+            "schema": SERVING_SCHEMA,
+            "created_unix": round(time.time(), 3),
+            "serving": batch,
+            "shape": {"rows": args.batch_rows,
+                      "chunk_rows": args.batch_chunk_rows,
+                      "trees": args.trees, "features": args.features,
+                      "seed": args.seed},
+        }
+        out = os.path.join(args.out_dir, f"serving_batch{suffix}.json")
+        atomic_write_json(out, artifact)
+        log(f"wrote {out}")
+        # never-slower gate: the pipeline must not cost wall-clock even
+        # where it cannot win (single-core hosts pay pure contention);
+        # a >10% median slowdown is the overlap machinery regressing,
+        # not scheduling noise
+        if batch["speedup"] < 0.90:
+            log("FAIL: pipelined batch tier is >10% SLOWER than "
+                "sequential — the overlap machinery itself regressed")
+            rc = 1
+
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

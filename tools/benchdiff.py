@@ -14,6 +14,11 @@ Accepted input formats (auto-detected per file):
 * raw bench.py rows       (``{"metric": ..., "value": ...}``)
 * run manifests           (``*.manifest.json`` — obs.manifest v1; the
   headline comes from ``result``, phases from ``phases``)
+* serving bench artifacts (``.bench/serving_*.json`` —
+  ``lightgbm-tpu/serving-bench/v1`` from tools/bench_serving.py):
+  online mode diffs p50 (headline threshold) / p99 (phase threshold) /
+  throughput / error-rate; batch mode diffs file-to-file seconds.
+  Serving and training artifacts are never cross-compared (exit 2).
 
 Usage:
     python tools/benchdiff.py OLD NEW [--threshold PCT]
@@ -39,6 +44,12 @@ PHASE_PCT = 25.0
 AUC_ABS = 0.002  # an AUC drop is a correctness smell, not a perf one
 
 MANIFEST_SCHEMA = "lightgbm-tpu/run-manifest/v1"
+SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
+# serving error-rate discipline: a regression needs BOTH an absolute
+# rise above this floor (noise guard; also covers a 0 baseline) and —
+# when the baseline had errors — a relative rise past the headline
+# threshold
+ERROR_RATE_ABS = 0.001
 
 
 def _load(path: str) -> dict:
@@ -46,12 +57,40 @@ def _load(path: str) -> dict:
         return json.load(fh)
 
 
+def _normalize_serving(raw: dict, rec: dict) -> dict:
+    """Serving artifacts: the headline is p50 latency (online) or
+    file-to-file seconds (batch); p99/throughput/error-rate ride in
+    ``aux`` for the serving-specific diff."""
+    s = dict(raw.get("serving") or {})
+    rec["kind"] = "serving"
+    rec["mode"] = s.get("mode", "online")
+    if rec["mode"] == "batch":
+        rec["value"] = s.get("file_to_file_s")
+        rec["unit"] = "s file-to-file"
+    else:
+        rec["value"] = s.get("p50_ms")
+        rec["unit"] = "ms p50"
+    rec["aux"] = {k: s.get(k) for k in
+                  ("p99_ms", "throughput_rps", "rows_per_s", "error_rate",
+                   "requests", "errors", "unpipelined_s", "speedup")
+                  if s.get(k) is not None}
+    rec["shape"] = raw.get("shape") or {}
+    rec["knobs"] = raw.get("knobs") or {}
+    if rec.get("value") in (None, 0, 0.0):
+        raise ValueError(
+            f"{rec['path']}: serving artifact has no usable headline "
+            f"({'file_to_file_s' if rec['mode'] == 'batch' else 'p50_ms'})")
+    return rec
+
+
 def normalize(path: str) -> dict:
     """One record shape for every accepted input format:
     ``{label, value, unit, vs_baseline, auc..., phases, compile...}``."""
     raw = _load(path)
     rec: dict = {"label": os.path.basename(path), "path": path,
-                 "phases": {}, "sha": None}
+                 "phases": {}, "sha": None, "kind": "training"}
+    if raw.get("schema") == SERVING_SCHEMA or "serving" in raw:
+        return _normalize_serving(raw, rec)
     if raw.get("schema") == MANIFEST_SCHEMA:
         row = dict(raw.get("result") or {})
         rec["phases"] = dict(raw.get("phases") or {})
@@ -91,11 +130,80 @@ def _pct(old: float, new: float) -> float:
     return (new - old) / old * 100.0 if old else float("inf")
 
 
+def diff_serving(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
+                 phase_pct: float = PHASE_PCT) -> dict:
+    """Serving-artifact comparison under the same threshold discipline
+    as training: headline (p50 / file-to-file) +headline_pct is a
+    regression, p99 gets the looser phase threshold (tail latency is
+    noisier), a throughput drop past the headline threshold regresses,
+    and an error-rate rise is judged by ERROR_RATE_ABS + the relative
+    headline threshold."""
+    regressions, warnings, improvements = [], [], []
+    if old.get("mode") != new.get("mode"):
+        raise ValueError(
+            f"serving modes differ (old: {old.get('mode')}, new: "
+            f"{new.get('mode')}) — online and batch artifacts are not "
+            "comparable")
+    unit = new.get("unit", "")
+    ov, nv = float(old["value"]), float(new["value"])
+    head = _pct(ov, nv)
+    headline = {"old": ov, "new": nv, "unit": unit,
+                "delta_pct": round(head, 1)}
+    if head >= headline_pct:
+        regressions.append(
+            f"headline {unit} {ov:.4g} -> {nv:.4g} (+{head:.1f}%, "
+            f"threshold +{headline_pct:.0f}%)")
+    elif head <= -headline_pct:
+        improvements.append(
+            f"headline {unit} {ov:.4g} -> {nv:.4g} ({head:.1f}%)")
+
+    oa, na = old.get("aux") or {}, new.get("aux") or {}
+    for key, thresh, lower_is_better in (
+            ("p99_ms", phase_pct, True),
+            ("throughput_rps", headline_pct, False),
+            ("rows_per_s", headline_pct, False)):
+        if oa.get(key) and na.get(key):
+            d = _pct(float(oa[key]), float(na[key]))
+            worse = d >= thresh if lower_is_better else d <= -thresh
+            better = d <= -thresh if lower_is_better else d >= thresh
+            if worse:
+                regressions.append(
+                    f"{key} {oa[key]:.4g} -> {na[key]:.4g} "
+                    f"({d:+.1f}%, threshold {thresh:.0f}%)")
+            elif better:
+                improvements.append(
+                    f"{key} {oa[key]:.4g} -> {na[key]:.4g} ({d:+.1f}%)")
+    oe = float(oa.get("error_rate") or 0.0)
+    ne = float(na.get("error_rate") or 0.0)
+    if ne > oe + ERROR_RATE_ABS and (
+            oe == 0 or _pct(oe, ne) >= headline_pct):
+        regressions.append(
+            f"error_rate {oe:.4f} -> {ne:.4f} — serving errors are a "
+            "correctness regression, not a perf tradeoff")
+    elif oe > ne + ERROR_RATE_ABS:
+        improvements.append(f"error_rate {oe:.4f} -> {ne:.4f}")
+
+    os_, ns = old.get("shape") or {}, new.get("shape") or {}
+    if os_ and ns and os_ != ns:
+        warnings.append(
+            f"load shapes differ (old: {os_}, new: {ns}) — comparison "
+            "may not be apples-to-apples")
+    return {"headline": headline, "regressions": regressions,
+            "warnings": warnings, "improvements": improvements}
+
+
 def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
          phase_pct: float = PHASE_PCT) -> dict:
     """Compare two normalized records; returns
     ``{regressions: [...], warnings: [...], improvements: [...],
     headline: {...}}``."""
+    if "serving" in (old.get("kind"), new.get("kind")):
+        if old.get("kind") != new.get("kind"):
+            raise ValueError(
+                f"{old['label']} is a {old.get('kind')} artifact, "
+                f"{new['label']} is a {new.get('kind')} artifact — "
+                "serving and training results are not comparable")
+        return diff_serving(old, new, headline_pct, phase_pct)
     regressions, warnings, improvements = [], [], []
 
     if old.get("metric") and new.get("metric") \
@@ -229,25 +337,30 @@ def main(argv: Optional[list] = None) -> int:
 
     try:
         old, new = normalize(args.old), normalize(args.new)
+        report = diff(old, new, args.threshold, args.phase_threshold)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"benchdiff: {e}", file=sys.stderr)
         return 2
 
-    report = diff(old, new, args.threshold, args.phase_threshold)
     h = report["headline"]
     print(f"benchdiff: {old['label']} -> {new['label']}")
     delta = ("n/a" if h["delta_pct"] is None
              else f"{h['delta_pct']:+.1f}%")
-    print(f"  headline: {h['old_s_per_tree']:.4f} -> "
-          f"{h['new_s_per_tree']:.4f} s/tree ({delta})")
+    if new.get("kind") == "serving":
+        print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
+              f"{h['unit']} ({delta})")
+    else:
+        print(f"  headline: {h['old_s_per_tree']:.4f} -> "
+              f"{h['new_s_per_tree']:.4f} s/tree ({delta})")
     for r in report["regressions"]:
         print(f"  REGRESSION: {r}")
     for w in report["warnings"]:
         print(f"  warning: {w}")
     for i in report["improvements"]:
         print(f"  improvement: {i}")
-    print("  driver-config row (paste into the commit message):")
-    print("  " + driver_row(new))
+    if new.get("kind") != "serving":
+        print("  driver-config row (paste into the commit message):")
+        print("  " + driver_row(new))
 
     if args.json:
         # atomic (tmp + rename, the resilience.atomic protocol inlined —

@@ -15,6 +15,13 @@ status; the fault matrix lives in docs/resilience.md):
   loudly, policy=skip_tree finishes with a usable model.
 * ``collective``  — inject one transient collective failure; the
   retry-with-backoff wrapper must recover.
+* ``serve_swap``  — corrupt a serving hot-swap candidate
+  (``corrupt_model`` fault); the swap must be refused via the checksum
+  and the OLD model must keep answering bitwise-identically, then a
+  clean candidate must swap in.
+* ``serve_fail_write`` — fail the batch-tier result writer's atomic
+  commit (``fail_write_once``) mid predict_file; the existing result
+  must stay intact and no partial file may appear.
 
 Modes:
 
@@ -51,7 +58,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
-             "collective")
+             "collective", "serve_swap", "serve_fail_write")
 
 
 def log(msg: str) -> None:
@@ -166,6 +173,106 @@ def scenario_nan_grads_inproc(tmp: str, trees: int) -> str:
     assert rc == 0, f"policy=skip_tree rc={rc}"
     assert os.path.exists(m_skip), "skip_tree produced no model"
     return "nan grads -> raise aborts loudly, skip_tree degrades gracefully"
+
+
+def scenario_serve_swap_inproc(tmp: str, trees: int) -> str:
+    """Serving fault scenario 1: a corrupt hot-swap candidate must be
+    refused via the checksum sidecar, the old model keeps answering
+    bitwise, and a clean candidate then swaps in."""
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.resilience import faults
+    from lightgbm_tpu.resilience.atomic import ArtifactCorrupt
+    from lightgbm_tpu.serving import (MicroBatchQueue, ServingEngine,
+                                      adopt_model)
+
+    data = os.path.join(tmp, "ds.csv")
+    make_data(data, 300, seed=11)
+    m_a = os.path.join(tmp, "serve_a.txt")
+    m_b = os.path.join(tmp, "serve_b.txt")
+    rc, _ = _run_inproc(train_args(data, m_a, trees) + ["verbose=-1"])
+    assert rc == 0, f"model A train rc={rc}"
+    # the new boosting round: continued training from A
+    rc, _ = _run_inproc(train_args(data, m_b, 2, [f"input_model={m_a}",
+                                                  "verbose=-1"]))
+    assert rc == 0, f"model B train rc={rc}"
+
+    Xq = np.random.RandomState(12).randn(24, 6)
+    exp_a = Booster(model_file=m_a).predict(Xq)
+    exp_b = Booster(model_file=m_b).predict(Xq)
+    engine = ServingEngine(m_a, buckets=(8, 32), max_batch_rows=32)
+    with MicroBatchQueue(engine, max_delay_s=0.001) as q:
+        before = q.predict(Xq).values
+        assert before.tobytes() == exp_a.tobytes(), "pre-swap mismatch"
+
+        cand = os.path.join(tmp, "cand.txt")
+        shutil.copy(m_b, cand)
+        shutil.copy(m_b + ".sha256", cand + ".sha256")
+        faults.set_fault("corrupt_model")
+        try:
+            adopt_model(engine, cand)
+            raise AssertionError("corrupt candidate was ADOPTED")
+        except ArtifactCorrupt:
+            pass
+        finally:
+            faults.clear_faults()
+        mid = q.predict(Xq).values
+        assert mid.tobytes() == exp_a.tobytes(), (
+            "old model no longer answering bitwise after refused swap")
+
+        adopt_model(engine, m_b)
+        after = q.predict(Xq).values
+        assert after.tobytes() == exp_b.tobytes(), (
+            "post-swap responses do not match the new model bitwise")
+    return ("corrupt candidate refused (checksum), old model kept "
+            "serving bitwise; clean candidate swapped in")
+
+
+def scenario_serve_fail_write_inproc(tmp: str) -> str:
+    """Serving fault scenario 2: fail_write_once on the batch-tier
+    result writer — the previous result file must stay intact and no
+    partial/tmp file may be left behind."""
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.cli import Predictor
+    from lightgbm_tpu.resilience import faults
+    from lightgbm_tpu.resilience.faults import InjectedFault
+
+    data = os.path.join(tmp, "dw.csv")
+    make_data(data, 200, seed=13)
+    model = os.path.join(tmp, "serve_w.txt")
+    rc, _ = _run_inproc(train_args(data, model, 3) + ["verbose=-1"])
+    assert rc == 0, f"train rc={rc}"
+
+    pred_in = os.path.join(tmp, "pred_in.csv")
+    rows = np.random.RandomState(14).randn(300, 6)
+    np.savetxt(pred_in, np.column_stack([np.zeros(300), rows]),
+               fmt="%.6g", delimiter=",")
+    result = os.path.join(tmp, "result.txt")
+    p = Predictor(Booster(model_file=model), False, False)
+    p.stream_threshold = 1  # force the streamed (pipelined) path
+    p.chunk_rows = 64
+    p.predict_file(pred_in, result)
+    v1 = open(result, "rb").read()
+    assert v1, "first predict produced no result"
+
+    faults.set_fault("fail_write_once")
+    try:
+        p.predict_file(pred_in, result)
+        raise AssertionError("injected write failure did not fire")
+    except InjectedFault:
+        pass
+    finally:
+        faults.clear_faults()
+    assert open(result, "rb").read() == v1, (
+        "result file corrupted by the failed pipelined write")
+    litter = [f for f in os.listdir(tmp)
+              if f.startswith(os.path.basename(result) + ".tmp")]
+    assert not litter, f"partial result files leaked: {litter}"
+    return ("pipelined writer failed before commit -> previous result "
+            "intact, no partial files")
 
 
 def scenario_collective_inproc(tmp: str) -> str:
@@ -303,6 +410,8 @@ def main() -> int:
         run("fail_write", scenario_fail_write_inproc, tmp)
         run("nan_grads", scenario_nan_grads_inproc, tmp, args.trees)
         run("collective", scenario_collective_inproc, tmp)
+        run("serve_swap", scenario_serve_swap_inproc, tmp, 4)
+        run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
     else:
         run("kill_resume", scenario_kill_resume_subproc, tmp, args.trees,
             args.seed)
@@ -311,6 +420,10 @@ def main() -> int:
         run("fail_write", scenario_fail_write_inproc, tmp)
         run("nan_grads", scenario_nan_grads_inproc, tmp, args.trees)
         run("collective", scenario_collective_inproc, tmp)
+        # the serving scenarios are in-process in both modes: the fault
+        # surface (checksum verify, atomic commit) is process-local
+        run("serve_swap", scenario_serve_swap_inproc, tmp, 4)
+        run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
 
     summary = {"mode": "dryrun" if args.dryrun else "subprocess",
                "seed": args.seed, "failures": failures,
