@@ -115,10 +115,22 @@ AST_RULES: Dict[str, str] = {
         "resilience.atomic_write / atomic_write_json / atomic_writer "
         "(tmp + fsync + rename); append-mode logs are exempt"
     ),
+    "unbounded-event-buffer": (
+        "append/extend to a module-level list from function code in a "
+        "hot/serving/obs module with no maxlen/ring discipline: a "
+        "long-lived serving replica grows it without bound until the "
+        "host OOMs (per-request event logs are the classic case).  Use "
+        "collections.deque(maxlen=N) — append+evict is one atomic, "
+        "capped operation (obs/flightrec.py's ring is the pattern)"
+    ),
 }
 
 _HOT_DIR_PARTS = ("learners", "ops", "parallel")
 _HOT_FILES = ("gbdt.py", "engine.py")
+# unbounded-event-buffer scope: the hot modules PLUS the long-lived
+# server/observability tiers, where an uncapped event list outlives
+# every request that fed it
+_EVENT_SCOPE_DIR_PARTS = ("serving", "obs")
 
 _NP_NAMES = {"np", "numpy", "onp"}
 # numpy calls that pull data to (or materialize on) the host; pure
@@ -240,13 +252,20 @@ class _RuleWalker(ast.NodeVisitor):
 
     def __init__(self, path: str, traced: bool, hot: bool,
                  findings: List[Finding],
-                 jit_roots: Optional[Set[str]] = None) -> None:
+                 jit_roots: Optional[Set[str]] = None,
+                 module_lists: Optional[Set[str]] = None,
+                 event_scope: bool = False) -> None:
         self.path = path
         self.traced = traced
         self.hot = hot
         self.findings = findings
         self.loop_depth = 0
         self.jit_roots = jit_roots or set()
+        # unbounded-event-buffer context: module-level bare-list names
+        # (no maxlen discipline possible) + whether this module is a
+        # hot/serving/obs scope the rule applies to
+        self.module_lists = module_lists or set()
+        self.event_scope = event_scope
         # wallclock-without-sync event streams (line-ordered within the
         # walked function; nested defs are walked separately)
         self._time_marks: Dict[str, List[int]] = {}
@@ -443,12 +462,34 @@ class _RuleWalker(ast.NodeVisitor):
                     "non-atomically — use resilience.atomic_write_json",
                 )
 
+    def _check_event_buffer(self, node: ast.Call,
+                            name: Optional[str]) -> None:
+        """unbounded-event-buffer: ``MODLIST.append(...)`` / ``.extend``
+        where MODLIST is a module-level bare list and this module is a
+        hot/serving/obs scope.  Module-import-time appends never reach
+        here (the walker only visits function bodies), so one-shot
+        registry building at import stays legal."""
+        if not self.event_scope or name is None:
+            return
+        parts = name.split(".")
+        if (len(parts) == 2 and parts[1] in ("append", "extend")
+                and parts[0] in self.module_lists):
+            self.flag(
+                "unbounded-event-buffer", node,
+                f"{parts[0]}.{parts[1]}() grows the module-level list "
+                f"'{parts[0]}' from request/runtime code with no "
+                "maxlen/ring discipline — a long-lived server "
+                "accumulates it forever; use collections.deque("
+                "maxlen=N) (obs/flightrec.py's ring is the pattern)",
+            )
+
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
         leaf = name.split(".")[-1] if name else None
 
         self._note_wallclock_call(node, name, leaf)
         self._check_raw_write(node, name)
+        self._check_event_buffer(node, name)
 
         # env-read-at-trace: os.environ.get(...) / os.getenv(...)
         if self.traced and name in ("os.environ.get", "os.getenv",
@@ -552,6 +593,40 @@ def _is_hot(path: str) -> bool:
     return parts[-1] in _HOT_FILES
 
 
+def _is_event_scope(path: str) -> bool:
+    """Where unbounded-event-buffer applies: the hot modules plus the
+    long-lived serving/obs tiers."""
+    if _is_hot(path):
+        return True
+    parts = path.replace(os.sep, "/").split("/")
+    return any(p in _EVENT_SCOPE_DIR_PARTS for p in parts[:-1])
+
+
+def _module_level_lists(tree: ast.Module) -> Set[str]:
+    """Names bound to a bare ``[]`` / ``list()`` at module top level —
+    the buffers with no possible maxlen discipline.  deque(maxlen=...)
+    and any other construction are not collected."""
+    names: Set[str] = set()
+    for stmt in tree.body:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        is_bare_list = isinstance(value, ast.List) or (
+            isinstance(value, ast.Call) and _dotted(value.func) == "list"
+            and not value.args and not value.keywords)
+        if not is_bare_list:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    return names
+
+
 def lint_source(source: str, path: str = "<string>",
                 hot: Optional[bool] = None,
                 rules: Optional[Iterable[str]] = None) -> List[Finding]:
@@ -564,12 +639,16 @@ def lint_source(source: str, path: str = "<string>",
     index.visit(tree)
     traced = _traced_functions(index)
     hot = _is_hot(path) if hot is None else hot
+    module_lists = _module_level_lists(tree)
+    event_scope = _is_event_scope(path)
 
     findings: List[Finding] = []
 
     def walk_fn(fn: ast.AST, is_traced: bool) -> None:
         walker = _RuleWalker(path, is_traced, hot, findings,
-                             jit_roots=index.jit_roots)
+                             jit_roots=index.jit_roots,
+                             module_lists=module_lists,
+                             event_scope=event_scope)
         for stmt in fn.body:  # type: ignore[attr-defined]
             walker.visit(stmt)
         walker.finish()

@@ -24,7 +24,7 @@ from .io.dataset import BinnedDataset
 from .log import Log
 from .models.dart import create_boosting
 from .models.gbdt import GBDT
-from .obs import RunManifest, manifest_path, telemetry
+from .obs import RunManifest, flightrec, manifest_path, telemetry
 from .objectives import create_objective
 from .resilience import EXIT_PREEMPTED
 from .serving.batch import DEFAULT_CHUNK_ROWS, DEFAULT_STREAM_THRESHOLD
@@ -147,6 +147,10 @@ def run_train(cfg: Config) -> GBDT:
     from .analysis.recompile import compile_counter
 
     compile_counter()
+    # a preempted/poisoned run dumps its flight recorder next to the
+    # model it was training (LGBM_TPU_FLIGHTREC_DIR overrides)
+    flightrec.configure_dir(
+        os.path.dirname(os.path.abspath(cfg.output_model)))
     if cfg.is_parallel and cfg.num_machines > 1:
         # Network::Init analog (application.cpp:190): attach this process
         # to the multi-host JAX runtime before any data loads, so the
@@ -402,10 +406,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             Log.fatal(f"Unknown task: {cfg.task!r}")
     except TrainingPreempted as ex:
         # distinct exit status (sysexits EX_TEMPFAIL): the supervisor
-        # re-launches with resume=true and loses nothing
+        # re-launches with resume=true and loses nothing.  The flight
+        # recorder dumps LAST so its tail is the preemption itself —
+        # checkpoint path, iteration, signal — next to the model.
         print(f"Preempted:\n{ex}", file=sys.stderr)
+        flightrec.record("preempted", iteration=ex.iteration,
+                         checkpoint=ex.path)
+        flightrec.dump(reason="preempted")
         return EXIT_PREEMPTED
     except Exception as ex:
+        from .resilience.guards import NonFiniteError
+
+        if isinstance(ex, NonFiniteError):
+            # the guard already recorded its trip at the raise site;
+            # the dump's tail names the escalation that killed the run
+            flightrec.record("nonfinite_abort", error=str(ex)[:400])
+            flightrec.dump(reason="nonfinite")
         print(f"Met Exceptions:\n{ex}", file=sys.stderr)
         return 1
     return 0

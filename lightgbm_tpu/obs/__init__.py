@@ -12,13 +12,21 @@ The runtime half of ROADMAP item 1's "make perf un-regressable"
   only read manifests don't pay for it).
 * :mod:`~lightgbm_tpu.obs.manifest` — ``RunManifest`` written next to
   every bench result artifact; diffed by ``tools/benchdiff.py``.
+* :mod:`~lightgbm_tpu.obs.tracing` — per-request ``TraceContext``
+  (trace id + stage clock) threaded through the serving tier; every
+  served response carries a per-stage latency breakdown.
+* :mod:`~lightgbm_tpu.obs.export` — Prometheus text exposition of the
+  telemetry snapshot (``GET /metrics`` on the serving server).
+* :mod:`~lightgbm_tpu.obs.flightrec` — lock-cheap last-N event ring,
+  dumped atomically (checksum sidecar) on preemption / guard trips /
+  serving failures for post-mortem.
 
 See docs/observability.md for the schemas and the reading guide.
 """
 
 from __future__ import annotations
 
-from . import telemetry  # noqa: F401
+from . import export, flightrec, telemetry, tracing  # noqa: F401
 from .manifest import (  # noqa: F401
     RunManifest,
     config_fingerprint,
@@ -26,20 +34,24 @@ from .manifest import (  # noqa: F401
     validate,
 )
 from .telemetry import (  # noqa: F401
+    Histogram,
     Reservoir,
     SpanStat,
     Telemetry,
     collective_stats,
     count,
+    count_many,
     emit_if_json,
     enabled,
     get_telemetry,
     host_sync,
+    observe,
     record_collectives,
     record_value,
     set_enabled,
     span,
 )
+from .tracing import TraceContext  # noqa: F401
 
 _LAZY = ("phase_scope", "host_annotation", "bucket_events",
          "classify_event", "phase_breakdown_from_trace",

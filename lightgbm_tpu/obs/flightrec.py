@@ -1,0 +1,195 @@
+"""Flight recorder: the last N structured events, dumped on the way down.
+
+A preempted serving replica (PR 11's exit-75 path) or a NaN-poisoned
+training run dies with nothing but whatever happened to be on stderr.
+This module keeps a lock-cheap ring of the most recent events —
+dispatches, hot-swaps, checkpoint writes, injected faults, guard trips,
+signals — and, when something terminal happens, dumps the ring
+atomically (``resilience.atomic``, with a ``.sha256`` sidecar) to
+``<dir>/flightrec_<pid>.json``.  The dump's TAIL is the triggering
+event: the writer records the trigger and then dumps, so a post-mortem
+reads the file backwards from the cause.
+
+Recording cost: one dict build + one ``deque.append`` — no lock on the
+record path.  The ring is a ``collections.deque(maxlen=cap)``: append
+and eviction are one atomic operation under the GIL, so concurrent
+recorders can interleave (events are re-sorted by ``seq`` on read) but
+can never grow the buffer past the cap or corrupt it — exactly the
+capped-buffer discipline the jaxlint ``unbounded-event-buffer`` rule
+exists to enforce on everyone else.  The dump lock only serializes
+dumps (and the rare capacity changes) against each other.
+
+Dump triggers (wired by this PR):
+
+* cli training — SIGTERM/SIGINT preemption (after the checkpoint), the
+  second-signal immediate abort, and a :class:`NonFiniteError` escape;
+* serving — a dispatcher-thread crash (the "unhandled dispatch
+  failure" that should never happen) and a refused hot-swap.
+
+The dump directory: ``LGBM_TPU_FLIGHTREC_DIR`` (read at import) wins;
+otherwise each entry point calls :func:`configure_dir` with a sensible
+sibling (next to ``output_model`` for training, next to the served
+model for ``task=serve``).  When neither is set, :func:`dump` is a
+no-op returning ``None`` — observability never surprises a library
+embedder with stray files.
+
+Retrieval workflow and format: docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+SCHEMA = "lightgbm-tpu/flightrec/v1"
+
+DEFAULT_CAP = 256
+
+# read once at import (repo convention for behavior knobs)
+_ENV_DIR = os.environ.get("LGBM_TPU_FLIGHTREC_DIR", "")
+try:
+    _ENV_CAP = int(os.environ.get("LGBM_TPU_FLIGHTREC_CAP",
+                                  str(DEFAULT_CAP)))
+except ValueError:
+    # a malformed knob must not make the whole package unimportable
+    _ENV_CAP = DEFAULT_CAP
+
+# the ring: append + oldest-eviction is ONE atomic deque operation, so
+# concurrent recorders cannot grow it past the cap (see module docstring)
+_EVENTS: Deque[dict] = collections.deque(maxlen=max(1, _ENV_CAP))
+# seq via itertools.count: next() is atomic under the GIL, so ids stay
+# unique and contiguous across threads
+_SEQ = itertools.count()
+_STATE: Dict[str, object] = {"dir": _ENV_DIR}
+_DUMP_LOCK = threading.Lock()
+
+
+def record(kind: str, **fields) -> None:
+    """Append one structured event to the ring.  ``kind`` is a short
+    snake_case tag; ``fields`` must be JSON-able scalars/strings."""
+    ev = {"seq": next(_SEQ), "t_mono": round(time.perf_counter(), 6),
+          "unix": round(time.time(), 3), "kind": kind}
+    if fields:
+        ev.update(fields)
+    _EVENTS.append(ev)
+
+
+def events() -> List[dict]:
+    """Chronological copy of the ring's current contents.  Concurrent
+    recorders may append out of seq order (mint-then-append is two
+    steps); sorting by seq restores the true timeline.  A concurrent
+    append invalidates a live deque iterator (RuntimeError), so the
+    copy retries — the record rate is per-batch/per-incident, so a
+    clean window is always near (and losing the post-mortem to a torn
+    copy would defeat the module)."""
+    buf: List[dict] = []
+    for _ in range(64):
+        try:
+            buf = list(_EVENTS)
+            break
+        except RuntimeError:  # deque mutated during iteration
+            continue
+    else:
+        # pathological write storm: element-index reads tolerate
+        # concurrent appends (a best-effort partial copy still beats
+        # losing the post-mortem)
+        for i in range(len(_EVENTS)):
+            try:
+                buf.append(_EVENTS[i])
+            except IndexError:
+                break
+    return sorted(buf, key=lambda e: e["seq"])
+
+
+def dropped() -> int:
+    """Events that have aged out of the ring (seqs are contiguous, so
+    total-recorded minus retained is exact up to a concurrent append)."""
+    buf = events()
+    if not buf:
+        return 0
+    return max(0, buf[-1]["seq"] + 1 - len(buf))
+
+
+def configure_dir(fallback: str) -> str:
+    """Entry-point wiring: the env override wins, else ``fallback``.
+    Called per run (cli train / serve), so a long-lived test process
+    follows each run's artifact directory."""
+    d = _ENV_DIR or fallback
+    _STATE["dir"] = d
+    return d
+
+
+def set_dump_dir(d: str) -> None:
+    """Explicit override (chaos scenarios, tests)."""
+    _STATE["dir"] = d
+
+
+def dump_dir() -> str:
+    return str(_STATE["dir"] or "")
+
+
+def set_capacity(cap: int) -> None:
+    """Resize the ring (tests).  Clears it and restarts the seq."""
+    global _EVENTS, _SEQ
+    if cap < 1:
+        raise ValueError(f"flight recorder cap must be >= 1, got {cap}")
+    with _DUMP_LOCK:
+        _EVENTS = collections.deque(maxlen=int(cap))
+        _SEQ = itertools.count()
+
+
+def reset() -> None:
+    global _SEQ
+    with _DUMP_LOCK:
+        _EVENTS.clear()
+        _SEQ = itertools.count()
+
+
+def dump_path(directory: Optional[str] = None) -> Optional[str]:
+    d = directory or dump_dir()
+    if not d:
+        return None
+    return os.path.join(d, f"flightrec_{os.getpid()}.json")
+
+
+def dump(reason: str = "", directory: Optional[str] = None
+         ) -> Optional[str]:
+    """Write the ring to ``<dir>/flightrec_<pid>.json`` atomically with
+    a checksum sidecar.  Returns the path, or None when no directory is
+    configured.  NEVER raises — this runs on the way down (signal
+    handlers, terminal excepts), and the dump failing must not mask the
+    original failure."""
+    path = dump_path(directory)
+    if path is None:
+        return None
+    try:
+        with _DUMP_LOCK:
+            payload = {
+                "schema": SCHEMA,
+                "pid": os.getpid(),
+                "created_unix": round(time.time(), 3),
+                "reason": reason,
+                "dropped": dropped(),
+                "events": events(),
+            }
+        from ..resilience.atomic import atomic_write_json
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        atomic_write_json(path, payload, checksum=True)
+        from . import telemetry
+
+        telemetry.count("flightrec.dumps")
+        return path
+    except Exception as e:  # noqa: BLE001 — last-gasp writer, see docstring
+        try:
+            from ..log import Log
+
+            Log.warning(f"flight-recorder dump to {path} failed: "
+                        f"{type(e).__name__}: {e}")
+        except Exception:  # noqa: BLE001
+            pass
+        return None

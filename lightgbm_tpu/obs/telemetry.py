@@ -10,7 +10,9 @@ Design constraints, in order:
 
 * **Near-zero overhead on the hot path.**  A span is two
   ``time.perf_counter()`` calls and two dict operations; a counter is
-  one dict add.  Nothing here touches a device array, forces a sync, or
+  one uncontended-lock acquisition and one dict add (the lock arrived
+  with the multi-threaded serving tier — see the :class:`Telemetry`
+  docstring).  Nothing here touches a device array, forces a sync, or
   allocates per-iteration beyond a float append.  The bound is itself
   an acceptance criterion (``tools/telemetry_overhead.py``, ≤2% at the
   100k driver-like shape, artifact in ``.bench/``).
@@ -47,6 +49,7 @@ not key on env — same convention the env-read-at-trace rule enforces);
 
 from __future__ import annotations
 
+import bisect
 import json
 import re
 import sys
@@ -59,6 +62,15 @@ from typing import Dict, List, Optional
 TELEMETRY_MODE = _environ.get("LGBM_TPU_TELEMETRY", "on").strip().lower()
 
 _RESERVOIR_CAP = 4096
+
+# fixed latency buckets (seconds) for Prometheus-style histograms: the
+# serving stage clocks span ~0.1 ms (pad on a warm bucket) to seconds
+# (a cold dispatch); log-ish spacing keeps the tail resolvable without
+# per-request allocation.  STABLE — these boundaries are part of the
+# /metrics contract (docs/observability.md), change = new metric name.
+DEFAULT_LATENCY_BOUNDS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class SpanStat:
@@ -125,6 +137,15 @@ class Reservoir:
         k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
         return s[k]
 
+    def clone(self) -> "Reservoir":
+        """Cheap copy (one list copy) so percentile sorting can happen
+        OUTSIDE the telemetry store lock — a /metrics scrape must not
+        stall request-path writers for the duration of ~18 sorts."""
+        c = Reservoir(self.cap)
+        c._buf = list(self._buf)
+        c._n = self._n
+        return c
+
     def as_dict(self) -> dict:
         window = len(self._buf)
         mean = sum(self._buf) / window if window else 0.0
@@ -136,6 +157,38 @@ class Reservoir:
             "p99_s": round(self.percentile(99), 6),
             "max_s": round(max(self._buf), 6) if window else 0.0,
         }
+
+
+class Histogram:
+    """Fixed-bucket histogram (the Prometheus exposition shape).
+
+    Complements :class:`Reservoir`: the reservoir answers "what do the
+    most recent requests cost" (sliding window, exact quantiles); the
+    histogram is cumulative over the process lifetime and exports as
+    ``_bucket{le=...}/_sum/_count`` series a scraper can rate() and
+    aggregate across replicas — which windowed quantiles cannot.
+    ``observe`` is one bisect + three adds.
+    """
+
+    __slots__ = ("bounds", "counts", "total", "sum")
+
+    def __init__(self, bounds=DEFAULT_LATENCY_BOUNDS) -> None:
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(self.bounds) or not self.bounds:
+            raise ValueError(f"histogram bounds must be sorted and "
+                             f"non-empty, got {bounds!r}")
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf bucket
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.total += 1
+        self.sum += v
+
+    def as_dict(self) -> dict:
+        return {"bounds": list(self.bounds), "counts": list(self.counts),
+                "count": self.total, "sum": round(self.sum, 9)}
 
 
 class _Span:
@@ -171,19 +224,35 @@ _NULL_SPAN = _NullSpan()
 
 
 class Telemetry:
-    """Process-wide telemetry store (counters, spans, reservoirs).
+    """Process-wide telemetry store (counters, spans, reservoirs,
+    histograms).
 
-    Increment paths rely on the GIL for consistency (a torn telemetry
-    count is acceptable; a lock on the hot path is not); the lock only
-    guards snapshot/reset so a concurrent reader sees a coherent copy.
+    Every mutation takes the one store lock.  This changed with the
+    serving observability PR: the training loop is single-threaded (the
+    GIL made torn counts a non-issue), but the serving tier increments
+    from many request threads at once, where ``d[k] = d.get(k) + n``
+    LOSES increments and a ``/v1/stats`` snapshot could see the rows
+    counter ahead of the requests counter it rode in with.  An
+    uncontended ``threading.Lock`` is tens of nanoseconds — re-proven
+    below the noise floor by ``tools/telemetry_overhead.py`` — and in
+    exchange :meth:`snapshot` is one consistent cut: everything it
+    returns was simultaneously true.  Related adds that must move
+    together go through :meth:`count_many` (one acquisition).
     """
 
     def __init__(self, enabled: bool = True) -> None:
         self.enabled = enabled
-        self._lock = threading.Lock()
+        # RLock, not Lock: the preemption path runs flightrec.dump()
+        # (which counts) from a SIGNAL HANDLER on the main thread — if
+        # the signal interrupted a frame that already holds the store
+        # lock, a non-reentrant lock would deadlock the "Ctrl-C twice"
+        # abort.  Re-entry can at worst lose the interrupted frame's
+        # single increment; a hang needs SIGKILL.
+        self._lock = threading.RLock()
         self._counters: Dict[str, float] = {}
         self._spans: Dict[str, SpanStat] = {}
         self._reservoirs: Dict[str, Reservoir] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     # ------------------------------------------------------------- record
     def span(self, name: str):
@@ -193,24 +262,94 @@ class Telemetry:
         return _Span(self, name)
 
     def _record_span(self, name: str, dt: float) -> None:
-        st = self._spans.get(name)
-        if st is None:
-            st = self._spans.setdefault(name, SpanStat())
-        st.add(dt)
+        with self._lock:
+            st = self._spans.get(name)
+            if st is None:
+                st = self._spans.setdefault(name, SpanStat())
+            st.add(dt)
 
     def count(self, name: str, n: float = 1) -> None:
         """Monotonic counter add (no-op when disabled)."""
         if self.enabled:
-            self._counters[name] = self._counters.get(name, 0) + n
+            with self._lock:
+                self._counters[name] = self._counters.get(name, 0) + n
+
+    def count_many(self, adds: Dict[str, float]) -> None:
+        """Several counter adds under ONE lock acquisition — for pairs
+        that must never be observed half-applied (``serving.requests``
+        and ``serving.rows``: a snapshot between two separate adds
+        would report traffic whose row count belongs to no request
+        count)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for name, n in adds.items():
+                self._counters[name] = self._counters.get(name, 0) + n
 
     def record_value(self, name: str, v: float) -> None:
         """Append one sample to the named reservoir (e.g. per-tree s)."""
         if not self.enabled:
             return
+        with self._lock:
+            r = self._reservoirs.get(name)
+            if r is None:
+                r = self._reservoirs.setdefault(name, Reservoir())
+            r.add(v)
+
+    def observe(self, name: str, v: float, bounds=None) -> None:
+        """One sample into the named fixed-bucket histogram (the
+        ``/metrics`` exposition shape; see :class:`Histogram` for why
+        this exists next to the reservoirs).  ``bounds`` applies only
+        on first touch of a name."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms.setdefault(
+                    name, Histogram(bounds or DEFAULT_LATENCY_BOUNDS))
+            h.observe(v)
+
+    def _sample_sinks(self, name: str):
+        """Get-or-create the (reservoir, histogram) pair a latency
+        series feeds.  Caller holds the store lock."""
         r = self._reservoirs.get(name)
         if r is None:
             r = self._reservoirs.setdefault(name, Reservoir())
-        r.add(v)
+        h = self._histograms.get(name)
+        if h is None:
+            h = self._histograms.setdefault(name, Histogram())
+        return r, h
+
+    def record_samples(self, samples: Dict[str, float]) -> None:
+        """Several latency samples under ONE lock acquisition, each
+        feeding its reservoir AND its histogram — the serving scatter
+        path records five series per request (four stages + the
+        end-to-end), and five-times-two separate acquisitions were the
+        dominant tracing cost on the 1-core container (measured by
+        ``tools/telemetry_overhead.py --serving``)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for name, v in samples.items():
+                r, h = self._sample_sinks(name)
+                r.add(v)
+                h.observe(v)
+
+    def record_sample_lists(self, samples: Dict[str, List[float]]) -> None:
+        """Batch form of :meth:`record_samples`: one lock acquisition
+        for a whole coalesced batch's worth of per-request samples —
+        the serving dispatcher records once per BATCH, keeping the
+        tracing cost on its critical path independent of how many
+        requests coalesced."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for name, vals in samples.items():
+                r, h = self._sample_sinks(name)
+                for v in vals:
+                    r.add(v)
+                    h.observe(v)
 
     def host_sync(self, n: int = 1) -> None:
         """Record a deliberate device->host materialization point."""
@@ -226,8 +365,15 @@ class Telemetry:
     def span_stat(self, name: str) -> Optional[SpanStat]:
         return self._spans.get(name)
 
+    def histogram(self, name: str) -> Optional[Histogram]:
+        return self._histograms.get(name)
+
     def snapshot(self, include_compiles: bool = True) -> dict:
-        """Coherent copy of everything, as plain JSON-able dicts.
+        """ONE consistent cut of everything, as plain JSON-able dicts:
+        the store lock is held across the whole copy and every writer
+        takes the same lock, so no snapshot can observe one counter of
+        a related pair updated and the other not (``/v1/stats`` and
+        ``/metrics`` both read through here).
 
         ``backend_compiles`` is bridged in from the analysis subsystem's
         process-wide listener at snapshot time (importing jax only if
@@ -238,7 +384,12 @@ class Telemetry:
         with self._lock:
             counters = dict(self._counters)
             spans = {k: v.as_dict() for k, v in self._spans.items()}
-            reservoirs = {k: v.as_dict() for k, v in self._reservoirs.items()}
+            # clone, don't as_dict: percentile sorting over up-to-4096
+            # samples per reservoir happens outside the lock, so a
+            # scrape can't stall every request-path writer meanwhile
+            res_clones = {k: v.clone() for k, v in self._reservoirs.items()}
+            histograms = {k: v.as_dict() for k, v in self._histograms.items()}
+        reservoirs = {k: v.as_dict() for k, v in res_clones.items()}
         if include_compiles and "jax" in sys.modules:
             try:
                 from lightgbm_tpu.analysis.recompile import (
@@ -248,13 +399,14 @@ class Telemetry:
             except Exception:
                 pass
         return {"counters": counters, "spans": spans,
-                "reservoirs": reservoirs}
+                "reservoirs": reservoirs, "histograms": histograms}
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._spans.clear()
             self._reservoirs.clear()
+            self._histograms.clear()
 
     def emit(self, stream=None) -> None:
         """One JSON line of the full snapshot (``LGBM_TPU_TELEMETRY=json``
@@ -291,8 +443,24 @@ def count(name: str, n: float = 1) -> None:
     _TELEMETRY.count(name, n)
 
 
+def count_many(adds: Dict[str, float]) -> None:
+    _TELEMETRY.count_many(adds)
+
+
 def record_value(name: str, v: float) -> None:
     _TELEMETRY.record_value(name, v)
+
+
+def observe(name: str, v: float, bounds=None) -> None:
+    _TELEMETRY.observe(name, v, bounds=bounds)
+
+
+def record_samples(samples: Dict[str, float]) -> None:
+    _TELEMETRY.record_samples(samples)
+
+
+def record_sample_lists(samples: Dict[str, List[float]]) -> None:
+    _TELEMETRY.record_sample_lists(samples)
 
 
 def host_sync(n: int = 1) -> None:

@@ -42,7 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..log import Log
-from ..obs import telemetry
+from ..obs import flightrec, telemetry
 from ..obs.manifest import _git_info, config_fingerprint
 from . import EXIT_PREEMPTED
 from . import faults
@@ -402,14 +402,22 @@ class CheckpointManager:
             # minutes-long iteration is in flight) — restore the default
             # disposition and re-raise, aborting immediately without a
             # checkpoint.  Ctrl-C twice must never require SIGKILL.
+            # No checkpoint on this path, so the flight recorder is the
+            # ONLY record of how far the run got — dump before dying.
             Log.warning(
                 f"second {signal.Signals(signum).name}: aborting "
                 "immediately (no checkpoint)")
+            flightrec.record("signal",
+                             signal=signal.Signals(signum).name,
+                             second=True)
+            flightrec.dump(reason="second_signal")
             signal.signal(signum,
                           self._old_handlers.get(signum, signal.SIG_DFL))
             os.kill(os.getpid(), signum)
             return
         self._stop_signum = signum
+        flightrec.record("signal", signal=signal.Signals(signum).name,
+                         second=False)
         Log.warning(
             f"received {signal.Signals(signum).name}; finishing the "
             "in-flight iteration, then checkpointing and exiting "
@@ -453,6 +461,7 @@ class CheckpointManager:
             Log.warning(f"FAULT corrupt_checkpoint: corrupted {path}")
         self._last_sha = _file_payload_sha(path)
         prune_checkpoints(self.dir)
+        flightrec.record("checkpoint", path=path, iteration=completed)
         Log.info(f"Checkpoint written: {path} (iteration {completed})")
         return path
 

@@ -99,6 +99,19 @@ def _consume(kind: str) -> None:
     _CONSUMED.add(kind)
 
 
+def _note(kind: str, **fields) -> None:
+    """Record the injection in the flight recorder (lazy import — this
+    module must stay importable with nothing but the stdlib; a chaos
+    post-mortem that does not show its own injected faults would send
+    the reader chasing a phantom)."""
+    try:
+        from ..obs import flightrec
+
+        flightrec.record("fault_injected", fault=kind, **fields)
+    except Exception:  # noqa: BLE001 — never let observability break injection
+        pass
+
+
 # ------------------------------------------------------- injection points
 def kill_after_tree() -> Optional[int]:
     """Iteration count after which the training loop should receive
@@ -114,6 +127,7 @@ def maybe_kill(completed_iterations: int) -> None:
     k = kill_after_tree()
     if k is not None and completed_iterations == k:
         _consume("kill_after_tree")
+        _note("kill_after_tree", iteration=completed_iterations)
         os.kill(os.getpid(), signal.SIGTERM)
 
 
@@ -122,6 +136,7 @@ def maybe_fail_write(path: str) -> None:
     the rename: the crash window the atomic protocol exists to survive."""
     if fault_active("fail_write_once") is not None:
         _consume("fail_write_once")
+        _note("fail_write_once", path=path)
         raise InjectedWriteError(
             f"injected write failure before committing {path}")
 
@@ -131,6 +146,7 @@ def maybe_fail_collective() -> None:
     vocabulary real collective stacks use (retry_transient keys on it)."""
     if fault_active("fail_collective_once") is not None:
         _consume("fail_collective_once")
+        _note("fail_collective_once")
         raise InjectedCollectiveError(
             "UNAVAILABLE: injected transient collective failure")
 
@@ -154,6 +170,7 @@ def maybe_corrupt_checkpoint(path: str) -> bool:
     if fault_active("corrupt_checkpoint") is None:
         return False
     _overwrite_mid_file(path)
+    _note("corrupt_checkpoint", path=path)
     return True
 
 
@@ -165,6 +182,7 @@ def maybe_corrupt_model(path: str) -> bool:
     if fault_active("corrupt_model") is None or not os.path.exists(path):
         return False
     _overwrite_mid_file(path)
+    _note("corrupt_model", path=path)
     return True
 
 
@@ -177,6 +195,7 @@ def poison_grads(grad, hess, iteration: int):
     if p is None or iteration != int(p or 0):
         return grad, hess
     _consume("nan_grads")
+    _note("nan_grads", iteration=iteration)
     grad = grad.at[..., 0].set(float("nan")) if hasattr(grad, "at") else _np_poison(grad, float("nan"))
     hess = hess.at[..., 0].set(float("inf")) if hasattr(hess, "at") else _np_poison(hess, float("inf"))
     return grad, hess

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ..log import Log
-from ..obs import telemetry
+from ..obs import flightrec, telemetry
 
 POLICIES = ("off", "raise", "skip_tree", "clip")
 
@@ -107,6 +107,9 @@ class NonFiniteGuard:
                 telemetry.count("nonfinite_grad_events")
                 telemetry.count("nonfinite_skipped_trees")
                 self._consecutive_skips += 1
+                flightrec.record("guard_trip", policy="skip_tree",
+                                 nonfinite=int(n),
+                                 consecutive=self._consecutive_skips)
                 if self._consecutive_skips >= MAX_CONSECUTIVE_SKIPS:
                     # a skip changes no state, so deterministic NaN
                     # sources (inf init_score, a broken objective) would
@@ -174,6 +177,8 @@ class NonFiniteGuard:
         bad = sum(counts)
         if bad:
             telemetry.count("nonfinite_grad_events")
+            flightrec.record("guard_trip", policy="raise",
+                             nonfinite=int(bad))
             if booster is not None and snap is not None:
                 booster.restore_state(snap)
             raise NonFiniteError(

@@ -38,7 +38,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from ..obs import telemetry
+from ..obs import flightrec, telemetry
 from ..resilience.atomic import atomic_writer
 
 # inputs above this size stream through parse_file_chunks (the
@@ -239,6 +239,9 @@ def pipelined_predict_file(booster, data_path: str, result_path: str,
         # failed; any failure above leaves the destination untouched
     stats["parse_wait_s"] = round(stats["parse_wait_s"], 6)
     stats["wall_s"] = round(time.perf_counter() - t0, 6)
-    telemetry.count("serving.batch.files")
-    telemetry.count("serving.batch.rows", stats["rows"])
+    # files and their rows move together — one consistent add
+    telemetry.count_many({"serving.batch.files": 1,
+                          "serving.batch.rows": stats["rows"]})
+    flightrec.record("batch_predict", rows=stats["rows"],
+                     chunks=stats["chunks"], result=result_path)
     return stats

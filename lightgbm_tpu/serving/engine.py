@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from ..log import Log
-from ..obs import telemetry
+from ..obs import flightrec, telemetry
 
 DEFAULT_MAX_BATCH_ROWS = 1024
 DEFAULT_MIN_BUCKET = 8
@@ -200,6 +200,10 @@ class ServingEngine:
         self.max_batch_rows = self.buckets[-1]
         self._swap_lock = threading.Lock()
         self._active = pm
+        # monotonic adoption timestamp: healthz reports its age so a
+        # load balancer can tell "just flipped" from "steady" (set at
+        # construction too — engine start IS the first adoption)
+        self._swap_monotonic = time.perf_counter()
         if warm:
             self.prewarm()
 
@@ -237,6 +241,13 @@ class ServingEngine:
     def num_class(self) -> int:
         return self._active.num_class
 
+    @property
+    def last_swap_age_s(self) -> float:
+        """Seconds since the active model was last (s)wapped in — the
+        healthz readiness field (a freshly-flipped replica may still be
+        filling caches; a balancer can ease it back in)."""
+        return time.perf_counter() - self._swap_monotonic
+
     def bucket_for(self, n: int) -> int:
         """Smallest bucket covering ``n`` rows (callers chunk anything
         above the largest bucket)."""
@@ -246,25 +257,41 @@ class ServingEngine:
         return self.buckets[-1]
 
     # ---------------------------------------------------------- dispatch
-    def _dispatch_rows(self, pm: PackedModel, Xc: np.ndarray) -> np.ndarray:
+    def _dispatch_rows(self, pm: PackedModel, Xc: np.ndarray,
+                       clock=None) -> np.ndarray:
         """One bucketed device dispatch: pad -> run -> slice.  Returns
         [K, n] float64 raw scores (the same f32->f64 materialization
-        point as GBDT._raw_scores, for bitwise transform parity)."""
+        point as GBDT._raw_scores, for bitwise transform parity).
+
+        ``clock`` (an ``obs.tracing.StageClock``) accumulates the two
+        engine-owned trace stages: ``pad_s`` (host pad/copy + the
+        jnp.asarray handoff) and ``device_s`` (jitted dispatch through
+        result materialization — the np.asarray below IS the device
+        wait, the same sync point the old code had)."""
         n = Xc.shape[0]
         b = self.bucket_for(n)
+        t0 = time.perf_counter() if clock is not None else 0.0
         Xp = np.zeros((b, pm.num_features), np.float32)
         Xp[:n] = Xc
-        out = _bucket_dispatch()(pm.tables, pm.stacked, jnp.asarray(Xp))
+        Xj = jnp.asarray(Xp)
+        if clock is not None:
+            t1 = time.perf_counter()
+            clock.add("pad_s", t1 - t0)
+        out = _bucket_dispatch()(pm.tables, pm.stacked, Xj)
+        res = np.asarray(out, np.float64)[:, :n]
+        if clock is not None:
+            clock.add("device_s", time.perf_counter() - t1)
         telemetry.count("serving.dispatches")
         telemetry.record_value("serving.batch_occupancy", n / b)
-        return np.asarray(out, np.float64)[:, :n]
+        return res
 
-    def predict_with_meta(self, X, raw_score: bool = False
-                          ) -> Tuple[np.ndarray, str]:
+    def predict_with_meta(self, X, raw_score: bool = False,
+                          clock=None) -> Tuple[np.ndarray, str]:
         """Serve one (possibly coalesced) batch; returns
         ``(values, model_id)``.  ``values`` is [n] for single-output
         models, [n, K] for multiclass — row-sliceable either way, which
-        is what the micro-batch queue's scatter relies on."""
+        is what the micro-batch queue's scatter relies on.  ``clock``
+        is threaded into every chunk dispatch (tracing stages)."""
         pm = self._active  # ONE read: the whole request serves one model
         X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
         if X.ndim == 1:
@@ -280,7 +307,7 @@ class ServingEngine:
         for lo in range(0, X.shape[0], self.max_batch_rows):
             # per-chunk materialization IS the product (same contract as
             # GBDT._raw_scores' chunk loop)
-            parts.append(self._dispatch_rows(pm, X[lo:lo + self.max_batch_rows]))  # jaxlint: disable=host-sync-in-loop
+            parts.append(self._dispatch_rows(pm, X[lo:lo + self.max_batch_rows], clock))  # jaxlint: disable=host-sync-in-loop
         raw = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
         if raw_score:
             from ..models.gbdt import raw_score_output
@@ -341,7 +368,11 @@ class ServingEngine:
                 f"{old.num_class} — response shape would change")
         with self._swap_lock:
             self._active = new_pm
+            self._swap_monotonic = time.perf_counter()
         telemetry.count("serving.swaps")
+        flightrec.record("swap", old_model_id=old.model_id[:16],
+                         new_model_id=new_pm.model_id[:16],
+                         num_trees=new_pm.num_trees)
         Log.info(
             f"serving: hot-swapped {old.model_id[:12]} "
             f"({old.num_trees} trees) -> {new_pm.model_id[:12]} "

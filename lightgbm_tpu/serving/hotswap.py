@@ -31,7 +31,7 @@ import os
 import time
 
 from ..log import Log
-from ..obs import telemetry
+from ..obs import flightrec, telemetry
 from ..resilience import faults
 from ..resilience.atomic import (ArtifactCorrupt, file_sha256,
                                  verify_sidecar)
@@ -91,8 +91,15 @@ def adopt_model(engine: ServingEngine, path: str,
         pm = load_packed_model(path, require_checksum=require_checksum)
         warm = engine.prewarm(pm)  # compiles land OFF the request path
         old_id = engine.swap(pm)
-    except BaseException:
+    except BaseException as e:
         telemetry.count("serving.swap_refused")
+        # a refused swap is a flight-recorder incident: something
+        # handed this replica a bad model — record the trigger, then
+        # dump so the post-mortem tail IS the refusal
+        flightrec.record("swap_refused", candidate=path,
+                         serving_model_id=engine.model_id[:16],
+                         error=f"{type(e).__name__}: {e}")
+        flightrec.dump(reason="swap_refused")
         Log.warning(
             f"serving: hot-swap of {path} refused; old model "
             f"{engine.model_id[:12]} keeps serving")

@@ -20,15 +20,27 @@ the engine row-chunks it internally — so oversized callers degrade to
 the batch path instead of erroring.
 
 Telemetry: per-request latency lands in the ``serving.request_s``
-reservoir (p50/p99 in every serving RunManifest), batch shape in
-``serving.batch_rows`` / ``serving.batch_occupancy``, queue pressure in
-``serving.queue_depth``; counters ``serving.requests`` / ``.rows`` /
-``.batches`` / ``.dispatch_errors``.
+reservoir (p50/p99 in every serving RunManifest) AND its fixed-bucket
+histogram (``/metrics``); each trace stage (queue wait / pad / device /
+scatter — ``obs/tracing.py``) feeds its own ``serving.stage.*``
+reservoir + histogram; batch shape in ``serving.batch_rows`` /
+``serving.batch_occupancy``, queue pressure in ``serving.queue_depth``;
+counters ``serving.requests`` / ``.rows`` / ``.batches`` /
+``.dispatch_errors``.
+
+Tracing: every ``submit()`` mints (or adopts — the HTTP front end
+forwards ``X-LGBM-Trace-Id``) a :class:`~lightgbm_tpu.obs.tracing.
+TraceContext`; the resolved :class:`PredictionResult` carries the
+trace id and the per-stage breakdown, whose stages sum to the
+end-to-end latency by construction (``scatter_s`` is the residual of
+real timestamps — the tier-1 pin).
 
 Error contract: an engine failure fails exactly the futures of the
 batch that hit it (each with the original exception); the dispatcher
 thread itself never dies, so one poisoned request cannot take the
-service down.
+service down.  A dispatcher-thread crash outside the guarded dispatch
+(the should-never-happen case) dumps the flight recorder on the way
+out (``obs/flightrec.py``).
 """
 
 from __future__ import annotations
@@ -37,42 +49,49 @@ import collections
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..obs import telemetry
+from ..obs import flightrec, telemetry, tracing
 
 DEFAULT_MAX_DELAY_S = 0.002
 
 
 class PredictionResult:
     """What a submitted future resolves to: the values, which model
-    answered (hot-swap provenance), and the submit->result latency."""
+    answered (hot-swap provenance), the submit->result latency, and the
+    trace identity + per-stage breakdown (empty when
+    ``LGBM_TPU_TRACING=off``)."""
 
-    __slots__ = ("values", "model_id", "latency_s")
+    __slots__ = ("values", "model_id", "latency_s", "trace_id", "stages")
 
     def __init__(self, values: np.ndarray, model_id: str,
-                 latency_s: float) -> None:
+                 latency_s: float, trace_id: str = "",
+                 stages: Optional[Dict[str, float]] = None) -> None:
         self.values = values
         self.model_id = model_id
         self.latency_s = latency_s
+        self.trace_id = trace_id
+        self.stages = stages if stages is not None else {}
 
     def __repr__(self) -> str:
         return (f"PredictionResult(n={len(self.values)}, "
                 f"model_id={self.model_id[:12]}…, "
-                f"latency_s={self.latency_s:.6f})")
+                f"latency_s={self.latency_s:.6f}, "
+                f"trace_id={self.trace_id[:12]})")
 
 
 class _Request:
-    __slots__ = ("X", "n", "future", "t_submit")
+    __slots__ = ("X", "n", "future", "t_submit", "trace")
 
     def __init__(self, X: np.ndarray, future: Future,
-                 t_submit: float) -> None:
+                 t_submit: float, trace=None) -> None:
         self.X = X
         self.n = X.shape[0]
         self.future = future
         self.t_submit = t_submit
+        self.trace = trace
 
 
 class MicroBatchQueue:
@@ -98,10 +117,13 @@ class MicroBatchQueue:
         self._thread.start()
 
     # ------------------------------------------------------------ submit
-    def submit(self, X) -> Future:
+    def submit(self, X, trace_id: Optional[str] = None) -> Future:
         """Enqueue one request; returns a Future resolving to a
         :class:`PredictionResult`.  The rows are copied to f32 at
-        submit time, so the caller may reuse its buffer immediately."""
+        submit time, so the caller may reuse its buffer immediately.
+        ``trace_id`` adopts a caller-supplied id (the HTTP header
+        path); otherwise one is minted here — submit() IS the trace
+        origin, so ``queue_wait_s`` starts now."""
         X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
         if X.ndim == 1:
             X = X[None, :]
@@ -114,20 +136,24 @@ class MicroBatchQueue:
                 f"request has {X.shape[1]} features, serving model "
                 f"expects {nf}")
         fut: Future = Future()
-        req = _Request(X, fut, time.perf_counter())
+        req = _Request(X, fut, time.perf_counter(),
+                       trace=tracing.mint(trace_id))
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatchQueue is closed")
             self._pending.append(req)
             self._pending_rows += req.n
             self._cond.notify_all()
-        telemetry.count("serving.requests")
-        telemetry.count("serving.rows", req.n)
+        # one lock acquisition: a stats/metrics snapshot must never see
+        # the request counted but its rows not (or vice versa)
+        telemetry.count_many({"serving.requests": 1,
+                              "serving.rows": req.n})
         return fut
 
-    def predict(self, X, timeout: float = 60.0) -> PredictionResult:
+    def predict(self, X, timeout: float = 60.0,
+                trace_id: Optional[str] = None) -> PredictionResult:
         """Blocking convenience: ``submit(X).result(timeout)``."""
-        return self.submit(X).result(timeout)
+        return self.submit(X, trace_id=trace_id).result(timeout)
 
     # --------------------------------------------------------- dispatcher
     def _take_batch(self) -> Optional[List[_Request]]:
@@ -161,11 +187,20 @@ class MicroBatchQueue:
             return batch
 
     def _loop(self) -> None:
-        while True:
-            batch = self._take_batch()
-            if batch is None:
-                return
-            self._dispatch(batch)
+        try:
+            while True:
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                self._dispatch(batch)
+        except BaseException as e:  # noqa: BLE001 — the should-never-happen path
+            # _dispatch already contains every per-batch failure; an
+            # exception HERE means the dispatcher itself is dying and
+            # the service is down — leave the post-mortem on the way out
+            flightrec.record("dispatcher_crash",
+                             error=f"{type(e).__name__}: {e}")
+            flightrec.dump(reason="dispatcher_crash")
+            raise
 
     @staticmethod
     def _resolve(fut: Future, result=None, exc=None) -> None:
@@ -183,25 +218,62 @@ class MicroBatchQueue:
 
     def _dispatch(self, batch: List[_Request]) -> None:
         rows = sum(r.n for r in batch)
+        # t0 closes every rider's queue_wait_s and opens the batch's
+        # dispatch window; pad_s/device_s are measured inside it by the
+        # engine, and scatter_s is the window's residual at each
+        # request's resolution — so the four stages sum EXACTLY to the
+        # end-to-end latency (the tier-1 pin; docs/observability.md)
         t0 = time.perf_counter()
+        clock = tracing.StageClock() if any(r.trace for r in batch) else None
         try:
             X = (batch[0].X if len(batch) == 1
                  else np.concatenate([r.X for r in batch], axis=0))
             vals, model_id = self._engine.predict_with_meta(
-                X, raw_score=self._raw_score)
+                X, raw_score=self._raw_score, clock=clock)
         except BaseException as e:  # noqa: BLE001 — fail the batch, not the service
             telemetry.count("serving.dispatch_errors")
+            flightrec.record("dispatch_error", rows=rows,
+                             requests=len(batch),
+                             error=f"{type(e).__name__}: {e}")
             for r in batch:
                 self._resolve(r.future, exc=e)
             return
         t1 = time.perf_counter()
+        pad_s = clock.get("pad_s") if clock is not None else 0.0
+        device_s = clock.get("device_s") if clock is not None else 0.0
+        flightrec.record("dispatch", rows=rows, requests=len(batch),
+                         model_id=model_id[:16],
+                         device_ms=round(device_s * 1e3, 3))
         lo = 0
+        # per-request samples accumulate host-side and commit in ONE
+        # store-lock acquisition after the scatter: the dispatcher's
+        # critical path pays a fixed tracing cost per batch, not per
+        # coalesced request (the tools/telemetry_overhead.py --serving
+        # A/B is the proof this stays below run-to-run noise)
+        samples: Dict[str, List[float]] = {"serving.request_s": []}
         for r in batch:
             out = vals[lo:lo + r.n]
             lo += r.n
-            lat = t1 - r.t_submit
-            self._resolve(r.future, PredictionResult(out, model_id, lat))
-            telemetry.record_value("serving.request_s", lat)
+            tr = r.trace
+            t_res = time.perf_counter()
+            lat = t_res - r.t_submit
+            samples["serving.request_s"].append(lat)
+            if tr is not None:
+                tr.add("queue_wait_s", max(0.0, t0 - r.t_submit))
+                tr.add("pad_s", pad_s)
+                tr.add("device_s", device_s)
+                tr.add("scatter_s",
+                       max(0.0, (t_res - t0) - pad_s - device_s))
+                for k, v in tr.stages.items():
+                    samples.setdefault(
+                        tracing.STAGE_METRIC_PREFIX + k, []).append(v)
+                result = PredictionResult(out, model_id, lat,
+                                          trace_id=tr.trace_id,
+                                          stages=dict(tr.stages))
+            else:
+                result = PredictionResult(out, model_id, lat)
+            self._resolve(r.future, result)
+        telemetry.record_sample_lists(samples)
         telemetry.count("serving.batches")
         telemetry.record_value("serving.batch_rows", rows)
         telemetry.record_value("serving.dispatch_s", t1 - t0)

@@ -7,17 +7,30 @@ tier-1 tests exercise exactly the request/response contract the wire
 speaks without paying socket overhead, and one HTTP smoke test covers
 the transport itself.
 
-Endpoints (JSON in/out):
+Endpoints (JSON in/out unless noted):
 
 =======================  ====================================================
 ``POST /v1/predict``     ``{"rows": [[...], ...], "raw_score": false}`` ->
-                         ``{"predictions": [...], "model_id": ..., "n": N}``
+                         ``{"predictions": [...], "model_id": ..., "n": N,
+                         "trace_id": ..., "stages": {queue_wait_s, pad_s,
+                         device_s, scatter_s}}``.  An inbound
+                         ``X-LGBM-Trace-Id`` header is honored (adopted as
+                         the trace id) and echoed on the response; without
+                         one, a fresh id is minted and still echoed.
 ``POST /v1/swap``        ``{"model": "/path/to/model.txt"}`` -> swap summary;
                          409 + error on a corrupt/unverifiable candidate
                          (the old model keeps serving)
-``GET  /v1/healthz``     engine identity + bucket set + queue depth
+``GET  /v1/healthz``     readiness payload: engine identity (model_id),
+                         seconds since the last model (s)wap, bucket
+                         ladder, queue depth — enough for a load balancer
+                         to drain a replica mid-swap.  Contract unchanged
+                         from the liveness days: 200 whenever alive.
 ``GET  /v1/stats``       full telemetry snapshot (serving reservoirs incl.
-                         request p50/p99, batch occupancy, queue depth)
+                         request p50/p99, stage breakdowns, batch
+                         occupancy, queue depth)
+``GET  /metrics``        Prometheus text exposition of the same snapshot
+                         (``obs/export.py``) + live gauges (queue depth,
+                         swap age) — the scrape endpoint
 =======================  ====================================================
 """
 
@@ -32,7 +45,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..log import Log
-from ..obs import RunManifest, telemetry
+from ..obs import RunManifest, telemetry, tracing
+from ..obs import export as metrics_export
 from ..resilience.atomic import ArtifactCorrupt
 from .engine import ServingEngine
 from .queue import MicroBatchQueue
@@ -41,8 +55,23 @@ _PREDICT_TIMEOUT_S = 120.0
 
 
 # ------------------------------------------------------------- handlers
+def _result_payload(values, model_id: str, trace_id: str = "",
+                    stages: Optional[dict] = None) -> dict:
+    """The one place the predict response shape is built (queue and
+    engine-direct paths both) — a new field added here reaches every
+    transport."""
+    out = {"predictions": np.asarray(values).tolist(),
+           "model_id": model_id,
+           "n": int(np.asarray(values).shape[0])}
+    if trace_id:
+        out["trace_id"] = trace_id
+        out["stages"] = {k: round(v, 6) for k, v in (stages or {}).items()}
+    return out
+
+
 def api_predict(engine: ServingEngine, queue: MicroBatchQueue,
-                payload: dict) -> Tuple[int, dict]:
+                payload: dict,
+                trace_id: Optional[str] = None) -> Tuple[int, dict]:
     rows = payload.get("rows")
     if rows is None:
         return 400, {"error": "missing 'rows'"}
@@ -54,27 +83,40 @@ def api_predict(engine: ServingEngine, queue: MicroBatchQueue,
     if raw != queue._raw_score:
         # the queue batches homogeneous work; per-request raw_score
         # would force per-request dispatch — serve it engine-direct,
-        # but feed the SAME traffic counters/reservoir the queue path
-        # feeds, or /v1/stats and the serving manifest undercount load
+        # but feed the SAME traffic counters/reservoirs the queue path
+        # feeds, or /v1/stats and the serving manifest undercount load.
+        # The trace rides too: no queue, so queue_wait_s is honestly 0
+        # and scatter_s is the transform+serialize residual.
+        trace = tracing.mint(trace_id)
         t0 = time.perf_counter()
         try:
-            vals, model_id = engine.predict_with_meta(X, raw_score=raw)
+            vals, model_id = engine.predict_with_meta(X, raw_score=raw,
+                                                      clock=trace)
         except ValueError as e:
             return 400, {"error": str(e)}
+        lat = time.perf_counter() - t0
         n = int(np.asarray(vals).shape[0])
-        telemetry.count("serving.requests")
-        telemetry.count("serving.rows", n)
-        telemetry.record_value("serving.request_s",
-                               time.perf_counter() - t0)
-        return 200, {"predictions": np.asarray(vals).tolist(),
-                     "model_id": model_id, "n": n}
+        telemetry.count_many({"serving.requests": 1, "serving.rows": n})
+        if trace is not None:
+            trace.add("queue_wait_s", 0.0)
+            trace.add("scatter_s",
+                      max(0.0, lat - trace.get("pad_s")
+                          - trace.get("device_s")))
+            tracing.record_stages(trace,
+                                  extra={"serving.request_s": lat})
+        else:
+            telemetry.record_samples({"serving.request_s": lat})
+        return 200, _result_payload(
+            vals, model_id,
+            trace_id=trace.trace_id if trace is not None else "",
+            stages=trace.stages if trace is not None else None)
     try:
-        res = queue.predict(X, timeout=_PREDICT_TIMEOUT_S)
+        res = queue.predict(X, timeout=_PREDICT_TIMEOUT_S,
+                            trace_id=trace_id)
     except ValueError as e:
         return 400, {"error": str(e)}
-    return 200, {"predictions": np.asarray(res.values).tolist(),
-                 "model_id": res.model_id,
-                 "n": int(np.asarray(res.values).shape[0])}
+    return 200, _result_payload(res.values, res.model_id,
+                                trace_id=res.trace_id, stages=res.stages)
 
 
 def api_swap(engine: ServingEngine, payload: dict,
@@ -96,7 +138,13 @@ def api_swap(engine: ServingEngine, payload: dict,
 
 def api_health(engine: ServingEngine,
                queue: MicroBatchQueue) -> Tuple[int, dict]:
+    """Readiness payload (the old liveness contract — 200 whenever the
+    process is alive — still holds; the body just got useful): which
+    model is serving, how long since it was (s)wapped in, the bucket
+    ladder, and the queue depth, so a load balancer can drain a replica
+    that is mid-swap or backlogged instead of blindly routing to it."""
     return 200, {"status": "ok", "queue_depth": queue.depth,
+                 "last_swap_age_s": round(engine.last_swap_age_s, 3),
                  **engine.describe()}
 
 
@@ -104,10 +152,31 @@ def api_stats() -> Tuple[int, dict]:
     return 200, {"telemetry": telemetry.get_telemetry().snapshot()}
 
 
+def api_metrics(engine: ServingEngine,
+                queue: MicroBatchQueue) -> Tuple[int, str]:
+    """``GET /metrics``: the whole telemetry snapshot in Prometheus
+    text format plus the live gauges a snapshot cannot carry.  Returns
+    ``(status, text_body)`` — the one non-JSON endpoint."""
+    gauges = {
+        "lgbm_serving_queue_depth": (
+            queue.depth, "requests waiting in the micro-batch queue"),
+        "lgbm_serving_last_swap_age_seconds": (
+            round(engine.last_swap_age_s, 3),
+            "seconds since the active model was adopted"),
+        "lgbm_serving_max_batch_rows": (
+            engine.max_batch_rows, "largest serving bucket (rows)"),
+        "lgbm_serving_bucket_count": (
+            len(engine.buckets), "size of the padded-shape bucket ladder"),
+    }
+    body = metrics_export.render_prometheus(
+        telemetry.get_telemetry().snapshot(), gauges=gauges)
+    return 200, body
+
+
 class InProcessClient:
     """The tier-1 client: same handlers, no sockets.  Every method
-    returns ``(status_code, payload_dict)`` exactly as the HTTP
-    transport would."""
+    returns ``(status_code, payload)`` exactly as the HTTP transport
+    would (``metrics()`` returns the exposition text, the rest dicts)."""
 
     def __init__(self, engine: ServingEngine, queue: MicroBatchQueue,
                  require_checksum: bool = True) -> None:
@@ -115,9 +184,11 @@ class InProcessClient:
         self.queue = queue
         self.require_checksum = require_checksum
 
-    def predict(self, rows, raw_score: bool = False) -> Tuple[int, dict]:
+    def predict(self, rows, raw_score: bool = False,
+                trace_id: Optional[str] = None) -> Tuple[int, dict]:
         return api_predict(self.engine, self.queue,
-                           {"rows": rows, "raw_score": raw_score})
+                           {"rows": rows, "raw_score": raw_score},
+                           trace_id=trace_id)
 
     def swap(self, model_path: str) -> Tuple[int, dict]:
         return api_swap(self.engine, {"model": model_path},
@@ -128,6 +199,9 @@ class InProcessClient:
 
     def stats(self) -> Tuple[int, dict]:
         return api_stats()
+
+    def metrics(self) -> Tuple[int, str]:
+        return api_metrics(self.engine, self.queue)
 
 
 # -------------------------------------------------------------- server
@@ -146,10 +220,22 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args) -> None:
         Log.debug("serve: " + fmt % args)
 
-    def _send(self, code: int, obj: dict) -> None:
+    def _send(self, code: int, obj: dict,
+              extra_headers: Optional[dict] = None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str,
+                   content_type: str = metrics_export.CONTENT_TYPE) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -161,6 +247,9 @@ class _Handler(BaseHTTPRequestHandler):
                                        self.server.queue))
             elif self.path == "/v1/stats":
                 self._send(*api_stats())
+            elif self.path == "/metrics":
+                self._send_text(*api_metrics(self.server.engine,
+                                             self.server.queue))
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
         except BrokenPipeError:  # client went away mid-response
@@ -180,8 +269,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if self.path == "/v1/predict":
-                self._send(*api_predict(self.server.engine,
-                                        self.server.queue, payload))
+                # honor a caller-supplied trace id (invalid/absent ->
+                # minted downstream) and echo whatever id the request
+                # ended up carrying, so the caller can correlate
+                header_tid = self.headers.get("X-LGBM-Trace-Id")
+                code, out = api_predict(self.server.engine,
+                                        self.server.queue, payload,
+                                        trace_id=header_tid)
+                echo = out.get("trace_id")
+                self._send(code, out,
+                           extra_headers={"X-LGBM-Trace-Id": echo}
+                           if echo else None)
             elif self.path == "/v1/swap":
                 self._send(*api_swap(
                     self.server.engine, payload,
@@ -254,8 +352,14 @@ def serve_from_config(cfg, block: bool = True) -> Optional[ServingServer]:
     writes the serving manifest next to the model."""
     if not cfg.input_model:
         raise ValueError("input_model should not be empty for serve task")
+    import os
+
+    from ..obs import flightrec
     from .hotswap import load_packed_model
 
+    # post-mortems land next to the served model (env override wins)
+    flightrec.configure_dir(
+        os.path.dirname(os.path.abspath(cfg.input_model)))
     pm = load_packed_model(cfg.input_model,
                            require_checksum=cfg.serve_require_checksum)
     buckets = None

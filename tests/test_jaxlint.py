@@ -293,6 +293,7 @@ def test_rule_table_complete():
         "env-read-at-trace", "f64-literal-in-traced",
         "jit-cache-miss-risk", "host-sync-in-loop",
         "wallclock-without-sync", "raw-artifact-write",
+        "unbounded-event-buffer",
     }
 
 

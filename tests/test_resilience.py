@@ -503,6 +503,14 @@ def test_chaos_dryrun_smoke():
     assert set(summary["results"]) == {
         "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
         "serve_swap", "serve_fail_write"}
+    # ISSUE 14: the preemption and refused-swap scenarios now also
+    # assert a flight-recorder post-mortem (atomic + checksum sidecar,
+    # tail = the triggering event) — pinned via the scenario details so
+    # a silently-weakened chaos assertion fails here
+    assert "flight-recorder dump (tail=preempted)" in \
+        summary["results"]["kill_resume"]["detail"]
+    assert "flight-recorder dump (tail=swap_refused)" in \
+        summary["results"]["serve_swap"]["detail"]
 
 
 @pytest.mark.slow

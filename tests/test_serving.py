@@ -623,7 +623,7 @@ def test_serving_soak_load_generator(tmp_path):
     own exit code (errors, steady compiles, pipeline speedup)."""
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "bench_serving.py"),
-         "--requests", "2000", "--clients", "32", "--swap",
+         "--requests", "2000", "--clients", "32", "--swap", "--online",
          "--batch-rows", "60000", "--train-rows", "5000",
          "--trees", "16", "--out-dir", str(tmp_path)],
         capture_output=True, text=True, timeout=1200, cwd=ROOT,

@@ -1,0 +1,338 @@
+"""Tier-1 gate for the serving observability layer (ISSUE 14):
+
+* every served response — queue, in-process client, HTTP — carries a
+  trace id and a per-stage latency breakdown whose stages sum to the
+  end-to-end latency (pinned under concurrent mixed-size load);
+* ``X-LGBM-Trace-Id`` is honored (adopted) and echoed on the wire;
+* ``GET /metrics`` serves valid Prometheus text exposition (checked by
+  a vendored-free regex parser) covering serving counters, the
+  queue-depth gauge, and the stage histograms;
+* ``GET /v1/healthz`` is a readiness payload (model id, last swap age,
+  bucket ladder, queue depth) that still honors the old 200-on-alive
+  contract;
+* ``tools/benchdiff.py`` flags a serving artifact with ONE stage
+  regressed >25% while the headline stays flat.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from lightgbm_tpu.cli import main  # noqa: E402
+from lightgbm_tpu.obs import telemetry, tracing  # noqa: E402
+from lightgbm_tpu.serving import (InProcessClient, MicroBatchQueue,  # noqa: E402
+                                  ServingEngine, adopt_model)
+
+N_FEAT = 6
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serving_obs")
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, N_FEAT)
+    y = (X[:, 0] + 0.3 * rng.randn(400) > 0).astype(np.float64)
+    data = str(tmp / "d.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    m_a, m_b = str(tmp / "a.txt"), str(tmp / "b.txt")
+    base = ["task=train", f"data={data}", "objective=binary",
+            "num_leaves=7", "min_data_in_leaf=5",
+            "is_save_binary_file=false", "verbose=-1"]
+    assert main(base + ["num_trees=6", f"output_model={m_a}"]) == 0
+    assert main(base + ["num_trees=4", f"input_model={m_a}",
+                        f"output_model={m_b}"]) == 0
+    return {"model_a": m_a, "model_b": m_b}
+
+
+@pytest.fixture()
+def engine_a(served):
+    return ServingEngine(served["model_a"], buckets=(8, 32, 128),
+                         max_batch_rows=128)
+
+
+# --------------------------------------------------------- trace basics
+def test_every_queue_response_carries_trace_and_stages(engine_a):
+    rng = np.random.RandomState(1)
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        res = q.predict(rng.randn(5, N_FEAT))
+    assert res.trace_id and len(res.trace_id) >= 16
+    assert set(res.stages) == set(tracing.STAGES)
+    assert all(v >= 0.0 for v in res.stages.values())
+    # the stage reservoirs AND histograms were fed
+    tel = telemetry.get_telemetry()
+    for stage in tracing.STAGES:
+        name = tracing.STAGE_METRIC_PREFIX + stage
+        assert tel.reservoir(name) is not None, name
+        assert tel.histogram(name) is not None, name
+
+
+def test_stage_sums_match_latency_under_concurrent_mixed_load(engine_a):
+    """ISSUE acceptance: per-stage breakdowns sum to within measurement
+    noise of the end-to-end latency, under concurrent mixed-size load.
+    (By construction scatter_s is the residual of real timestamps, so
+    'noise' here is float addition error.)"""
+    rng = np.random.RandomState(2)
+    pool = rng.randn(512, N_FEAT)
+    sizes = (1, 7, 20, 64)
+    results = []
+    res_lock = threading.Lock()
+    errors = []
+
+    def client(idx):
+        r = np.random.RandomState(idx + 10)
+        with MicroBatchQueue(engine_a, max_delay_s=0.0005) as q:
+            for _ in range(40):
+                n = sizes[r.randint(len(sizes))]
+                lo = r.randint(0, len(pool) - n)
+                try:
+                    res = q.predict(pool[lo:lo + n], timeout=60)
+                except Exception as e:  # noqa: BLE001 — asserted empty
+                    errors.append(e)
+                    return
+                with res_lock:
+                    results.append(res)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors[:3]
+    assert len(results) == 240
+    ids = {r.trace_id for r in results}
+    assert len(ids) == 240, "trace ids are not unique per request"
+    for res in results:
+        assert set(res.stages) == set(tracing.STAGES)
+        s = sum(res.stages.values())
+        assert abs(s - res.latency_s) < 1e-6, (
+            f"stages sum {s} != latency {res.latency_s} "
+            f"(stages {res.stages})")
+        # queue wait + device must be real time, not zero-stubbed
+        assert res.stages["device_s"] > 0.0
+
+
+def test_trace_id_honored_and_echoed_inprocess(served, engine_a):
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        client = InProcessClient(engine_a, q)
+        code, out = client.predict(np.zeros((2, N_FEAT)).tolist(),
+                                   trace_id="req-7f3a.check")
+        assert code == 200
+        assert out["trace_id"] == "req-7f3a.check"
+        assert set(out["stages"]) == set(tracing.STAGES)
+        # no id supplied -> minted, still present
+        code, out2 = client.predict(np.zeros((2, N_FEAT)).tolist())
+        assert code == 200 and out2["trace_id"]
+        assert out2["trace_id"] != out["trace_id"]
+        # invalid header value -> a fresh id is minted, not adopted
+        code, out3 = client.predict(np.zeros((2, N_FEAT)).tolist(),
+                                    trace_id="bad id\nwith newline")
+        assert code == 200
+        assert out3["trace_id"] != "bad id\nwith newline"
+        # a bare trailing newline must be rejected too ('$' + re.match
+        # would accept it — the regression this line pins)
+        code, out4 = client.predict(np.zeros((2, N_FEAT)).tolist(),
+                                    trace_id="abc\n")
+        assert code == 200
+        assert out4["trace_id"] != "abc\n" and "\n" not in out4["trace_id"]
+        # the engine-direct path (raw_score mismatching the queue)
+        # traces too: queue_wait is honestly zero there
+        code, raw = client.predict(np.zeros((2, N_FEAT)).tolist(),
+                                   raw_score=True, trace_id="raw-1")
+        assert code == 200 and raw["trace_id"] == "raw-1"
+        assert raw["stages"]["queue_wait_s"] == 0.0
+        assert set(raw["stages"]) == set(tracing.STAGES)
+
+
+def test_trace_id_honored_and_echoed_http(served, engine_a):
+    """The wire contract: header in -> same id out (header AND body),
+    plus per-stage fields in the body."""
+    import http.client
+
+    from lightgbm_tpu.serving import ServingServer
+
+    rng = np.random.RandomState(3)
+    Xq = rng.randn(4, N_FEAT)
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        server = ServingServer(engine_a, q, port=0).start()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=30)
+            conn.request("POST", "/v1/predict",
+                         json.dumps({"rows": Xq.tolist()}),
+                         {"Content-Type": "application/json",
+                          "X-LGBM-Trace-Id": "edge-42"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert resp.getheader("X-LGBM-Trace-Id") == "edge-42"
+            assert body["trace_id"] == "edge-42"
+            assert set(body["stages"]) == set(tracing.STAGES)
+            assert sum(body["stages"].values()) >= 0.0
+            # no header -> minted id still echoed on the response
+            conn.request("POST", "/v1/predict",
+                         json.dumps({"rows": Xq.tolist()}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert resp.getheader("X-LGBM-Trace-Id") == body["trace_id"]
+            assert body["trace_id"]
+            conn.close()
+        finally:
+            server.httpd.shutdown()
+            server.httpd.server_close()
+
+
+def test_tracing_off_serves_without_traces(engine_a):
+    """LGBM_TPU_TRACING=off (runtime switch): responses still serve,
+    with empty trace fields — the A/B the overhead proof flips."""
+    tracing.set_enabled(False)
+    try:
+        with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+            res = q.predict(np.zeros((3, N_FEAT)))
+        assert res.trace_id == ""
+        assert res.stages == {}
+    finally:
+        tracing.set_enabled(True)
+
+
+# ------------------------------------------------------------- /metrics
+# vendored-free Prometheus text-format check: every line is a comment
+# (# HELP / # TYPE) or `name{labels} value`
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\")*\})?"
+    r" -?(\d+(\.\d+)?([eE][+-]?\d+)?|\+?Inf|NaN)$")
+_COMMENT_LINE = re.compile(
+    r"^# (HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(counter|gauge|summary|histogram|untyped))$")
+
+
+def _assert_valid_exposition(text: str) -> None:
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        assert _METRIC_LINE.match(line) or _COMMENT_LINE.match(line), (
+            f"invalid exposition line: {line!r}")
+
+
+def test_metrics_endpoint_valid_prometheus(served, engine_a):
+    """ISSUE acceptance: /metrics parses as Prometheus text and covers
+    serving counters, the queue-depth gauge, and stage histograms."""
+    rng = np.random.RandomState(4)
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        for n in (1, 9, 40):
+            q.predict(rng.randn(n, N_FEAT))
+        client = InProcessClient(engine_a, q)
+        code, text = client.metrics()
+    assert code == 200
+    _assert_valid_exposition(text)
+    assert "lgbm_serving_requests_total " in text
+    assert "lgbm_serving_rows_total " in text
+    assert "lgbm_serving_queue_depth " in text
+    assert "lgbm_serving_last_swap_age_seconds " in text
+    for stage in tracing.STAGES:
+        assert f"lgbm_serving_stage_{stage}_bucket" in text, stage
+        assert f"lgbm_serving_stage_{stage}_count" in text, stage
+    # histogram buckets are cumulative and end at +Inf == _count
+    m = re.findall(
+        r'lgbm_serving_request_s_bucket\{le="([^"]+)"\} (\d+)', text)
+    assert m and m[-1][0] == "+Inf"
+    counts = [int(c) for _, c in m]
+    assert counts == sorted(counts), "histogram buckets not cumulative"
+    total = re.search(r"lgbm_serving_request_s_count (\d+)", text)
+    assert total and int(total.group(1)) == counts[-1]
+
+
+def test_metrics_over_http_content_type(served, engine_a):
+    import http.client
+
+    from lightgbm_tpu.serving import ServingServer
+
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        q.predict(np.zeros((2, N_FEAT)))
+        server = ServingServer(engine_a, q, port=0).start()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=30)
+            conn.request("GET", "/metrics", None, {})
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+            _assert_valid_exposition(body)
+            assert "lgbm_serving_queue_depth " in body
+            conn.close()
+        finally:
+            server.httpd.shutdown()
+            server.httpd.server_close()
+
+
+# -------------------------------------------------------------- healthz
+def test_healthz_readiness_payload(served, engine_a):
+    """Satellite: healthz is a readiness payload (model id, last swap
+    monotonic age, bucket ladder, queue depth) while keeping the old
+    200-on-alive contract."""
+    with MicroBatchQueue(engine_a, max_delay_s=0.001) as q:
+        client = InProcessClient(engine_a, q)
+        code, out = client.health()
+        assert code == 200 and out["status"] == "ok"
+        assert out["model_id"] == engine_a.model_id
+        assert out["buckets"] == [8, 32, 128]
+        assert out["queue_depth"] == 0
+        age_before = out["last_swap_age_s"]
+        assert age_before >= 0.0
+        # a hot-swap resets the age — the drain signal for balancers
+        adopt_model(engine_a, served["model_b"])
+        code, out2 = client.health()
+        assert code == 200
+        assert out2["model_id"] != out["model_id"]
+        assert out2["last_swap_age_s"] < age_before + 0.001
+
+
+# ----------------------------------------------- benchdiff stage gating
+def _stage_artifact(device_p50, p50=2.0):
+    stages = {"queue_wait": {"p50_ms": 0.8, "p99_ms": 2.0},
+              "pad": {"p50_ms": 0.1, "p99_ms": 0.3},
+              "device": {"p50_ms": device_p50,
+                         "p99_ms": device_p50 * 2.5},
+              "scatter": {"p50_ms": 0.1, "p99_ms": 0.2}}
+    return {"schema": "lightgbm-tpu/serving-bench/v1",
+            "serving": {"mode": "online", "p50_ms": p50, "p99_ms": 6.0,
+                        "throughput_rps": 900.0, "error_rate": 0.0,
+                        "requests": 1000, "stages": stages},
+            "shape": {"clients": 8}}
+
+
+def test_benchdiff_flags_stage_regression_with_flat_headline(tmp_path):
+    """ISSUE acceptance: one stage regressed >25% while the headline
+    stays flat -> non-zero exit naming the stage."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_stage_artifact(0.9)))
+    new.write_text(json.dumps(_stage_artifact(1.3)))  # +44%, p50 flat
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "benchdiff.py"),
+         str(old), str(new)],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "stage 'device'" in r.stdout
+    # the reverse direction is an improvement, not a regression
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "benchdiff.py"),
+         str(new), str(old)],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "improvement" in r.stdout

@@ -51,8 +51,11 @@ def test_disabled_telemetry_records_nothing():
         pass
     tel.count("c")
     tel.record_value("r", 1.0)
+    tel.observe("h", 0.5)
+    tel.record_samples({"s": 1.0})
     snap = tel.snapshot(include_compiles=False)
-    assert snap == {"counters": {}, "spans": {}, "reservoirs": {}}
+    assert snap == {"counters": {}, "spans": {}, "reservoirs": {},
+                    "histograms": {}}
 
 
 def test_reservoir_percentiles_and_window():

@@ -9,9 +9,11 @@ diffable by ``tools/benchdiff.py``:
   requests into the micro-batched serving stack (engine + queue);
   optionally performs a checksum-verified hot-swap at the halfway mark
   (``--swap``) to prove adoption under load at bench scale.  Reports
-  per-request p50/p99/mean latency, request+row throughput, error rate,
-  batch occupancy, and the steady-state compile count (must be 0 —
-  the recompile-free-by-construction claim, measured, not asserted).
+  per-request p50/p99/mean latency, a per-stage breakdown (queue_wait /
+  pad / device / scatter from the request-tracing reservoirs —
+  benchdiff gates each stage at +25%), request+row throughput, error
+  rate, batch occupancy, and the steady-state compile count (must be 0
+  — the recompile-free-by-construction claim, measured, not asserted).
 * **batch** (``--batch-rows N``) — file-to-file prediction of an
   N-row CSV through the OLD strictly-sequential path and the overlapped
   parse->predict->write pipeline (serving/batch.py), byte-comparing the
@@ -157,6 +159,21 @@ def bench_online(args, model: str, model2: str) -> dict:
     tel = telemetry.get_telemetry()
     batch_res = tel.reservoir("serving.batch_rows")
     occ_res = tel.reservoir("serving.batch_occupancy")
+    # per-stage breakdown from the request-tracing reservoirs
+    # (obs/tracing.py): where the latency actually went — the half of
+    # the artifact tools/benchdiff.py gates per-stage at +25%
+    stages = {}
+    from lightgbm_tpu.obs import tracing
+
+    for stage in tracing.STAGES:
+        r = tel.reservoir(tracing.STAGE_METRIC_PREFIX + stage)
+        if r is not None:
+            d = r.as_dict()
+            stages[stage.removesuffix("_s")] = {
+                "p50_ms": round(d["p50_s"] * 1e3, 4),
+                "p99_ms": round(d["p99_s"] * 1e3, 4),
+                "mean_ms": round(d["mean_s"] * 1e3, 4),
+            }
     result = {
         "mode": "online",
         "requests": total,
@@ -170,6 +187,7 @@ def bench_online(args, model: str, model2: str) -> dict:
         "p99_ms": round(_percentile(lat, 99) * 1e3, 4),
         "mean_ms": round(sum(lat) / max(n_ok, 1) * 1e3, 4),
         "max_ms": round((lat[-1] if lat else 0.0) * 1e3, 4),
+        "stages": stages,
         "batches": int(tel.counter("serving.batches")),
         "mean_batch_rows": (round(batch_res.as_dict()["mean_s"], 2)
                             if batch_res else None),
@@ -183,6 +201,9 @@ def bench_online(args, model: str, model2: str) -> dict:
         f"p50 {result['p50_ms']}ms p99 {result['p99_ms']}ms "
         f"{result['throughput_rps']} req/s, "
         f"steady compiles {result['compiles_steady']}")
+    if stages:
+        log("stage p50s (ms): " + ", ".join(
+            f"{k}={v['p50_ms']}" for k, v in stages.items()))
     return result
 
 

@@ -17,8 +17,12 @@ Accepted input formats (auto-detected per file):
 * serving bench artifacts (``.bench/serving_*.json`` —
   ``lightgbm-tpu/serving-bench/v1`` from tools/bench_serving.py):
   online mode diffs p50 (headline threshold) / p99 (phase threshold) /
-  throughput / error-rate; batch mode diffs file-to-file seconds.
-  Serving and training artifacts are never cross-compared (exit 2).
+  throughput / error-rate, plus PER-STAGE p50s (queue_wait / pad /
+  device / scatter, from the request-tracing breakdown) under the same
+  +25% per-phase rule training runs get — a stage can no longer
+  regress 3x while the headline hides it in noise.  Batch mode diffs
+  file-to-file seconds.  Serving and training artifacts are never
+  cross-compared (exit 2).
 
 Usage:
     python tools/benchdiff.py OLD NEW [--threshold PCT]
@@ -74,6 +78,7 @@ def _normalize_serving(raw: dict, rec: dict) -> dict:
                   ("p99_ms", "throughput_rps", "rows_per_s", "error_rate",
                    "requests", "errors", "unpipelined_s", "speedup")
                   if s.get(k) is not None}
+    rec["stages"] = dict(s.get("stages") or {})
     rec["shape"] = raw.get("shape") or {}
     rec["knobs"] = raw.get("knobs") or {}
     if rec.get("value") in (None, 0, 0.0):
@@ -173,6 +178,39 @@ def diff_serving(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
             elif better:
                 improvements.append(
                     f"{key} {oa[key]:.4g} -> {na[key]:.4g} ({d:+.1f}%)")
+    # per-stage regressions (request-tracing breakdown): same
+    # discipline as training phases — +phase_pct on a stage's p50 is a
+    # regression even when the headline stays flat (four small stages
+    # can hide one 3x stage inside headline noise), a stage present on
+    # only one side is reported, never silently dropped
+    ost, nst = old.get("stages") or {}, new.get("stages") or {}
+    if ost or nst:
+        for st in sorted(set(ost) ^ set(nst)):
+            side = "old" if st in ost else "new"
+            warnings.append(
+                f"stage '{st}' present only in the {side} artifact — "
+                "tracing coverage changed between the two runs")
+        for st in sorted(set(ost) & set(nst)):
+            o = float((ost[st] or {}).get("p50_ms") or 0.0)
+            n = float((nst[st] or {}).get("p50_ms") or 0.0)
+            if o <= 0 or n <= 0:
+                if max(o, n) > 0.05:
+                    warnings.append(
+                        f"stage '{st}' p50 {o:.4g} -> {n:.4g} ms (no "
+                        "baseline to diff against)")
+                continue
+            d = _pct(o, n)
+            if d >= phase_pct:
+                regressions.append(
+                    f"stage '{st}' p50 {o:.4g} -> {n:.4g} ms "
+                    f"(+{d:.1f}%, threshold +{phase_pct:.0f}%)")
+            elif d <= -phase_pct:
+                improvements.append(
+                    f"stage '{st}' p50 {o:.4g} -> {n:.4g} ms ({d:.1f}%)")
+    elif old.get("mode") == "online":
+        warnings.append("no per-stage breakdown on either side "
+                        "(re-run tools/bench_serving.py with tracing on)")
+
     oe = float(oa.get("error_rate") or 0.0)
     ne = float(na.get("error_rate") or 0.0)
     if ne > oe + ERROR_RATE_ABS and (

@@ -5,8 +5,10 @@ training runs, and FAIL loudly when one does not hold.
 Scenarios (each prints ``PASS``/``FAIL`` and contributes to the exit
 status; the fault matrix lives in docs/resilience.md):
 
-* ``kill_resume`` — preempt a training run (SIGTERM), resume it, assert
-  the final model file is BITWISE identical to an uninterrupted run.
+* ``kill_resume`` — preempt a training run (SIGTERM), assert the
+  flight-recorder post-mortem landed (atomic + checksum sidecar, tail =
+  the preemption; obs/flightrec.py), resume it, assert the final model
+  file is BITWISE identical to an uninterrupted run.
 * ``corrupt``     — corrupt the checkpoint after the kill; the resume
   attempt must refuse loudly (checksum), never train on garbage.
 * ``fail_write``  — fail an atomic_write before its rename; the
@@ -16,7 +18,8 @@ status; the fault matrix lives in docs/resilience.md):
 * ``collective``  — inject one transient collective failure; the
   retry-with-backoff wrapper must recover.
 * ``serve_swap``  — corrupt a serving hot-swap candidate
-  (``corrupt_model`` fault); the swap must be refused via the checksum
+  (``corrupt_model`` fault); the swap must be refused via the checksum,
+  the refusal must leave a flight-recorder dump (tail = the refusal),
   and the OLD model must keep answering bitwise-identically, then a
   clean candidate must swap in.
 * ``serve_fail_write`` — fail the batch-tier result writer's atomic
@@ -99,6 +102,31 @@ def _run_inproc(args, fault: str = "") -> tuple:
     return rc, err.getvalue()
 
 
+def _assert_flightrec_dump(directory: str, want_tail_kind: str,
+                           want_reason: str) -> None:
+    """The flight-recorder contract (ISSUE 14 acceptance): an atomic,
+    checksum-sidecar'd dump exists in ``directory`` and its TAIL is the
+    triggering event."""
+    from lightgbm_tpu.resilience.atomic import verify_sidecar
+
+    dumps = [os.path.join(directory, f) for f in os.listdir(directory)
+             if f.startswith("flightrec_") and f.endswith(".json")]
+    assert dumps, f"no flight-recorder dump in {directory}"
+    path = max(dumps, key=os.path.getmtime)
+    digest = verify_sidecar(path)  # ArtifactCorrupt on mismatch
+    assert digest is not None, f"{path}: dump has no .sha256 sidecar"
+    with open(path) as fh:
+        rec = json.load(fh)
+    assert rec["schema"] == "lightgbm-tpu/flightrec/v1", rec["schema"]
+    assert rec["reason"] == want_reason, (
+        f"dump reason {rec['reason']!r}, expected {want_reason!r}")
+    assert rec["events"], "flight-recorder dump carries no events"
+    tail = rec["events"][-1]["kind"]
+    assert tail == want_tail_kind, (
+        f"dump tail is {tail!r}, expected the triggering event "
+        f"{want_tail_kind!r}")
+
+
 def scenario_kill_resume_inproc(tmp: str, trees: int, kill_at: int) -> str:
     data = os.path.join(tmp, "d.csv")
     make_data(data, 400)
@@ -110,6 +138,9 @@ def scenario_kill_resume_inproc(tmp: str, trees: int, kill_at: int) -> str:
                         fault=f"kill_after_tree:{kill_at}")
     assert rc == 75, f"preempted train rc={rc}, expected 75 (EX_TEMPFAIL)"
     assert os.path.isdir(m_b + ".ckpt"), "no checkpoint dir after preemption"
+    # the preemption must leave a post-mortem next to the model whose
+    # tail IS the preemption (obs/flightrec.py)
+    _assert_flightrec_dump(tmp, "preempted", "preempted")
     rc, _ = _run_inproc(
         train_args(data, m_b, trees, ["snapshot_freq=1", "--resume"]))
     assert rc == 0, f"resume rc={rc}"
@@ -117,7 +148,8 @@ def scenario_kill_resume_inproc(tmp: str, trees: int, kill_at: int) -> str:
     assert a == b, (
         f"RESUMED MODEL DIFFERS from uninterrupted ({len(a)} vs {len(b)} "
         "bytes) — the bitwise-identity contract is broken")
-    return f"kill at iteration {kill_at} -> resume -> bitwise-identical model"
+    return (f"kill at iteration {kill_at} -> flight-recorder dump "
+            "(tail=preempted) -> resume -> bitwise-identical model")
 
 
 def scenario_corrupt_inproc(tmp: str, trees: int, kill_at: int) -> str:
@@ -198,10 +230,13 @@ def scenario_serve_swap_inproc(tmp: str, trees: int) -> str:
                                                   "verbose=-1"]))
     assert rc == 0, f"model B train rc={rc}"
 
+    from lightgbm_tpu.obs import flightrec
+
     Xq = np.random.RandomState(12).randn(24, 6)
     exp_a = Booster(model_file=m_a).predict(Xq)
     exp_b = Booster(model_file=m_b).predict(Xq)
     engine = ServingEngine(m_a, buckets=(8, 32), max_batch_rows=32)
+    flightrec.set_dump_dir(tmp)  # a standalone stack wires its own dir
     with MicroBatchQueue(engine, max_delay_s=0.001) as q:
         before = q.predict(Xq).values
         assert before.tobytes() == exp_a.tobytes(), "pre-swap mismatch"
@@ -217,6 +252,9 @@ def scenario_serve_swap_inproc(tmp: str, trees: int) -> str:
             pass
         finally:
             faults.clear_faults()
+        # the refusal must leave a post-mortem whose tail IS the
+        # refusal (and the injected fault is on the record too)
+        _assert_flightrec_dump(tmp, "swap_refused", "swap_refused")
         mid = q.predict(Xq).values
         assert mid.tobytes() == exp_a.tobytes(), (
             "old model no longer answering bitwise after refused swap")
@@ -225,8 +263,9 @@ def scenario_serve_swap_inproc(tmp: str, trees: int) -> str:
         after = q.predict(Xq).values
         assert after.tobytes() == exp_b.tobytes(), (
             "post-swap responses do not match the new model bitwise")
-    return ("corrupt candidate refused (checksum), old model kept "
-            "serving bitwise; clean candidate swapped in")
+    return ("corrupt candidate refused (checksum) + flight-recorder "
+            "dump (tail=swap_refused), old model kept serving bitwise; "
+            "clean candidate swapped in")
 
 
 def scenario_serve_fail_write_inproc(tmp: str) -> str:
@@ -333,6 +372,9 @@ def scenario_kill_resume_subproc(tmp: str, trees: int, seed: int) -> str:
         pass
     else:
         assert rc == 75, f"killed run rc={rc}, expected 75:\n{out[-1500:]}"
+        # the external SIGTERM leaves the same post-mortem the in-proc
+        # path does (the real handler, the real dump-on-exit)
+        _assert_flightrec_dump(tmp, "preempted", "preempted")
         rc, out = _run_train(
             train_args(data, m_b, trees, ["snapshot_freq=1", "resume=true"]))
         assert rc == 0, f"resume rc={rc}:\n{out[-1500:]}"

@@ -16,8 +16,18 @@ shape).  Protocol:
 
 Writes the proof to .bench/telemetry_overhead.json (committed artifact).
 
-Usage:  JAX_PLATFORMS=cpu python tools/telemetry_overhead.py
+``--serving`` measures the SERVING path instead: request tracing
+(obs/tracing.py — trace-id mint + four stage clocks + stage
+reservoir/histogram feeds per request) on vs off through the real
+engine+queue stack, same alternating-segment protocol, plus the
+``/metrics`` exporter's render cost.  Writes
+.bench/tracing_overhead.json.  The acceptance bar: tracing + exporter
+overhead at/below run-to-run noise.
+
+Usage:  JAX_PLATFORMS=cpu python tools/telemetry_overhead.py [--serving]
 Env:    OVH_ROWS (1e5), OVH_TREES (3), OVH_PAIRS (3), OVH_LIMIT_PCT (2)
+        OVH_SERVE_REQUESTS (1200), OVH_SERVE_CLIENTS (8),
+        OVH_SERVE_PAIRS (3), OVH_SERVE_LIMIT_PCT (5)
 """
 
 from __future__ import annotations
@@ -35,6 +45,14 @@ ROWS = int(float(os.environ.get("OVH_ROWS", 100_000)))
 TREES = int(os.environ.get("OVH_TREES", 3))
 PAIRS = int(os.environ.get("OVH_PAIRS", 3))
 LIMIT_PCT = float(os.environ.get("OVH_LIMIT_PCT", 2.0))
+
+SERVE_REQUESTS = int(os.environ.get("OVH_SERVE_REQUESTS", 1600))
+SERVE_CLIENTS = int(os.environ.get("OVH_SERVE_CLIENTS", 8))
+SERVE_PAIRS = int(os.environ.get("OVH_SERVE_PAIRS", 5))
+# looser than the training bound: single-core serving latency is
+# GIL-contended and carries multi-percent run-to-run noise — the claim
+# is "at/below noise", and the off/off self-noise is recorded alongside
+SERVE_LIMIT_PCT = float(os.environ.get("OVH_SERVE_LIMIT_PCT", 5.0))
 
 
 def log(msg: str) -> None:
@@ -129,9 +147,139 @@ def measure() -> dict:
     return out
 
 
+def measure_serving() -> dict:
+    """Tracing on/off A/B over the real serving stack + exporter cost.
+
+    One alternating segment = SERVE_REQUESTS requests from
+    SERVE_CLIENTS threads (mixed 1-32-row batches) through
+    engine+queue; ``tracing.set_enabled`` flips the whole tracing path
+    (mint, stage clocks, stage reservoir/histogram feeds).  Throughput
+    (wall per segment) is the comparison statistic — latency
+    percentiles on a contended single core are noisier than the effect
+    being measured.  The off/off segment spread is recorded so "below
+    noise" is a number, not a vibe."""
+    import threading
+
+    import jax
+
+    plat = os.environ.get("BENCH_PLATFORM") or os.environ.get(
+        "JAX_PLATFORMS")
+    if plat and "axon" not in plat:
+        jax.config.update("jax_platforms", plat)
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.obs import telemetry, tracing
+    from lightgbm_tpu.obs.export import render_prometheus
+    from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+    from lightgbm_tpu.serving.engine import PackedModel
+
+    platform = jax.devices()[0].platform
+    rng = np.random.RandomState(0)
+    X = rng.randn(20_000, 20).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    cfg = Config(objective="binary", num_leaves=31, max_bin=255,
+                 min_data_in_leaf=20)
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata, ds.num_data))
+    for _ in range(32):
+        booster.train_one_iter()
+    engine = ServingEngine(PackedModel.from_gbdt(booster),
+                           buckets=(8, 32, 128), max_batch_rows=128)
+    pool = rng.randn(4096, 20)
+
+    def segment(queue) -> float:
+        per_client = SERVE_REQUESTS // SERVE_CLIENTS
+
+        def client(idx: int) -> None:
+            r = np.random.RandomState(idx)
+            for _ in range(per_client):
+                n = r.randint(1, 33)
+                lo = r.randint(0, len(pool) - n)
+                queue.predict(pool[lo:lo + n], timeout=120.0)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(SERVE_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    was = tracing.enabled()
+    on_walls, off_walls, off_noise = [], [], []
+    with MicroBatchQueue(engine, max_delay_s=0.001) as queue:
+        tracing.set_enabled(False)
+        segment(queue)  # warm the whole stack off the clock
+        try:
+            for pair in range(SERVE_PAIRS):
+                tracing.set_enabled(False)
+                off_walls.append(segment(queue))
+                off_noise.append(segment(queue))  # off/off self-noise
+                tracing.set_enabled(True)
+                on_walls.append(segment(queue))
+                log(f"pair {pair}: off {off_walls[-1]:.3f}s / "
+                    f"{off_noise[-1]:.3f}s, on {on_walls[-1]:.3f}s")
+        finally:
+            tracing.set_enabled(was)
+
+    off_med = statistics.median(off_walls)
+    on_med = statistics.median(on_walls)
+    overhead_pct = (on_med - off_med) / off_med * 100.0
+    noise_pct = max(abs(a - b) / min(a, b) * 100.0
+                    for a, b in zip(off_walls, off_noise))
+
+    # exporter cost: a loaded snapshot rendered to Prometheus text
+    snap = telemetry.get_telemetry().snapshot()
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        body = render_prometheus(snap)
+    render_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    out = {
+        "mode": "serving-tracing",
+        "requests_per_segment": SERVE_REQUESTS,
+        "clients": SERVE_CLIENTS,
+        "pairs": SERVE_PAIRS,
+        "platform": platform,
+        "cpu_count": os.cpu_count() or 1,
+        "off_wall_s": round(off_med, 4),
+        "on_wall_s": round(on_med, 4),
+        "off_segments_s": [round(t, 4) for t in off_walls],
+        "off_noise_segments_s": [round(t, 4) for t in off_noise],
+        "on_segments_s": [round(t, 4) for t in on_walls],
+        "overhead_pct": round(overhead_pct, 3),
+        "off_off_noise_pct": round(noise_pct, 3),
+        "metrics_render_ms": round(render_ms, 4),
+        "metrics_body_bytes": len(body),
+        "limit_pct": SERVE_LIMIT_PCT,
+        # the acceptance phrasing verbatim: at/below run-to-run noise
+        "pass": overhead_pct <= max(SERVE_LIMIT_PCT, noise_pct),
+        "created_unix": round(time.time(), 1),
+    }
+    try:
+        from lightgbm_tpu.obs.manifest import _git_info
+
+        out["git_sha"] = _git_info().get("sha")
+    except Exception:
+        pass
+    return out
+
+
 def main() -> int:
-    out = measure()
-    path = os.path.join(REPO, ".bench", "telemetry_overhead.json")
+    serving = "--serving" in sys.argv[1:]
+    if serving:
+        out = measure_serving()
+        path = os.path.join(REPO, ".bench", "tracing_overhead.json")
+    else:
+        out = measure()
+        path = os.path.join(REPO, ".bench", "telemetry_overhead.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
     from lightgbm_tpu.resilience.atomic import atomic_write_json
 
