@@ -265,13 +265,51 @@ def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
     the grow-loop phase breakdown is bucketed out of it; otherwise
     phases stay empty (host timers cannot see inside the jitted loop).
     Best-effort: a manifest failure must not fail a finished training
-    run."""
+    run.
+
+    Multi-rank runs (obs/dist.py): every rank publishes its telemetry
+    snapshot into the exchange dir (``LGBM_TPU_RANK_OBS_DIR`` or a
+    ``<output_model>.manifest.json.rankobs`` sibling), rank 0 gathers,
+    merges, and writes the ONE manifest carrying a ``ranks[]`` section
+    plus the merged counters/skew — non-zero ranks write no manifest
+    (today's every-rank-writes-the-same-path race becomes the per-rank
+    snapshot files instead)."""
     try:
         phases = {}
         if profile_dir:
             from .obs.device_time import phase_breakdown_from_trace
 
             phases = phase_breakdown_from_trace(profile_dir)
+        ranks: list = []
+        extra: dict = {}
+        from .obs import dist
+
+        if dist.process_count() > 1:
+            xdir = dist.exchange_dir_for(manifest_path(cfg.output_model))
+            dist.write_rank_snapshot(xdir)
+            if dist.process_index() != 0:
+                Log.info(
+                    f"rank {dist.process_index()}: published telemetry "
+                    f"snapshot to {xdir}; rank 0 writes the merged "
+                    "manifest")
+                telemetry.emit_if_json()
+                return
+            try:
+                snaps = dist.gather_rank_snapshots(
+                    xdir, dist.process_count(), timeout_s=120.0)
+                ranks = dist.ranks_section(snaps)
+                extra["distributed"] = dist.merged_manifest_extra(
+                    dist.merge_snapshots(snaps))
+            except Exception as e:  # noqa: BLE001 — degrade, don't lose
+                # a peer that died before publishing must not cost the
+                # finished run its manifest: fall back to rank 0's own
+                # process-local view, with the failure ON the record
+                Log.warning(
+                    f"rank-snapshot gather failed ({type(e).__name__}: "
+                    f"{str(e)[:200]}); writing a single-rank manifest")
+                ranks = []
+                extra["distributed"] = {
+                    "gather_error": f"{type(e).__name__}: {str(e)[:300]}"}
         manifest = RunManifest.collect(
             "cli.train", config=cfg,
             result={"num_trees": booster.num_trees,
@@ -279,6 +317,8 @@ def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
                     "output_model": cfg.output_model},
             phases=phases,
             per_tree_reservoir="tree_dispatch_s",
+            ranks=ranks,
+            extra=extra,
         )
         path = manifest.write(manifest_path(cfg.output_model))
         Log.info(f"Wrote run manifest to {path}")

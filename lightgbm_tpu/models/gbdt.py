@@ -224,6 +224,8 @@ class GBDT:
                     f"tree_learner={tl} runs data-parallel across "
                     "processes (feature/voting sharding stays intra-host)"
                 )
+            from ..resilience.retry import collective_deadline_s
+
             return make_multihost_data_parallel_grower(
                 data_mesh(),  # all global devices
                 num_bins=self._num_bins,
@@ -231,6 +233,10 @@ class GBDT:
                 growth=self.config.tree_growth,
                 sorted_hist=self._use_pallas_hist(),
                 hist_pool=self._hist_pool_slots(),
+                # the config's collective deadline guards the sentinel's
+                # per-iteration allgather too (a preempted peer must
+                # fail the world loudly, not hang it)
+                collective_deadline=collective_deadline_s(self.config),
             )
         if tl == "serial" or len(jax.devices()) == 1:
             if self.config.tree_growth == "depthwise":

@@ -18,15 +18,18 @@ The runtime half of ROADMAP item 1's "make perf un-regressable"
 * :mod:`~lightgbm_tpu.obs.export` — Prometheus text exposition of the
   telemetry snapshot (``GET /metrics`` on the serving server).
 * :mod:`~lightgbm_tpu.obs.flightrec` — lock-cheap last-N event ring,
-  dumped atomically (checksum sidecar) on preemption / guard trips /
-  serving failures for post-mortem.
+  dumped atomically (checksum sidecar, rank-tagged filename) on
+  preemption / guard trips / serving failures for post-mortem.
+* :mod:`~lightgbm_tpu.obs.dist` — the cross-rank layer: rank-scoped
+  snapshots, merge + skew attribution, host-side snapshot exchange,
+  per-collective tracing (barrier-wait vs transfer), desync sentinels.
 
 See docs/observability.md for the schemas and the reading guide.
 """
 
 from __future__ import annotations
 
-from . import export, flightrec, telemetry, tracing  # noqa: F401
+from . import dist, export, flightrec, telemetry, tracing  # noqa: F401
 from .manifest import (  # noqa: F401
     RunManifest,
     config_fingerprint,

@@ -1,0 +1,709 @@
+"""Distributed-run observability: rank-scoped telemetry, cross-rank
+merging with skew attribution, per-collective tracing, desync sentinels.
+
+Everything the PR 7/PR 14 observability stack records is process-local:
+on a multi-chip run that means eight telemetry stores, eight flight
+recorders, and no way to say *which rank* was slow, *which collective*
+dominated, or *where* two ranks silently diverged.  This module is the
+cross-rank layer:
+
+* **Rank snapshots** — :func:`rank_snapshot` stamps a full telemetry
+  snapshot (reservoirs carrying their raw sample windows, so quantiles
+  stay recomputable after a merge) with the rank's identity
+  (``process_index``, device, pid, host).
+* **Merging + skew** — :func:`merge_snapshots` sums counters, merges
+  spans/reservoirs/histograms, and computes per-name cross-rank skew
+  (max−min, max/mean, which rank) — the number that turns "the run was
+  slow" into "rank 3 was slow".  :func:`attribute_stragglers` reads the
+  barrier-wait series: the straggler is the rank that waited LEAST (it
+  arrived last; everyone else's wait is time spent waiting for it).
+* **Exchange** — :func:`exchange_snapshots`: every rank atomically
+  writes ``rank_<i>.json`` into a shared directory; rank 0 polls with a
+  deadline and merges.  Host-side files, not a device collective, so
+  the 8-process CPU dryrun exercises the identical path a v5e-8 run
+  will use (and a hung peer costs a timeout, not a wedged collective).
+* **Per-collective tracing** — :func:`traced_collective` wraps a
+  host-blocking collective site: an optional cheap barrier is timed
+  separately (``*.wait_s`` — straggler time) from the payload op
+  (``*.transfer_s``), op kind and payload bytes feed the existing
+  ``collective_ops``/``collective_bytes`` counters per-op, and
+  transient retries attribute to the site's label.
+  :func:`record_collective_site` is the trace-time analog for
+  collectives that live INSIDE a jitted program (``data_parallel.py``'s
+  psum_scatter/all_gather sites): one counter per site per trace, so
+  the 3-collectives/split contract is checkable per-op, not just as an
+  HLO total.
+* **Desync sentinels** — :class:`DesyncSentinel` piggybacks a cheap
+  ``int32[3]`` fingerprint allgather on the per-iteration sync point;
+  a mismatch raises :class:`DesyncError` NAMING the diverging rank and
+  iteration (instead of bitwise divergence discovered post-hoc) and
+  leaves a flight-recorder dump (tail = ``desync_detected``).
+
+Env knobs (read once at import, repo convention):
+
+* ``LGBM_TPU_DESYNC_CHECK`` — ``1`` (default): verify every iteration;
+  ``N``: every N iterations; ``0``: off.
+* ``LGBM_TPU_COLLECTIVE_TRACE`` — ``on`` (default) | ``off``: when off,
+  traced_collective skips the barrier (no wait/transfer separation —
+  one collective per site instead of two) and records transfer only.
+
+No jax import at module import (the exchange/merge half must stay
+importable from tools); rank identity is resolved lazily.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import sys
+import time
+import zlib
+from os import environ as _environ
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import flightrec, telemetry
+
+RANK_SCHEMA = "lightgbm-tpu/rank-snapshot/v1"
+MERGED_SCHEMA = "lightgbm-tpu/merged-telemetry/v1"
+MULTICHIP_SCHEMA = "lightgbm-tpu/multichip-bench/v1"
+
+# read once at import — see module docstring
+try:
+    DESYNC_CHECK_EVERY = int(_environ.get("LGBM_TPU_DESYNC_CHECK", "1"))
+except ValueError:
+    DESYNC_CHECK_EVERY = 1
+COLLECTIVE_TRACE = _environ.get(
+    "LGBM_TPU_COLLECTIVE_TRACE", "on").strip().lower() != "off"
+
+
+# ------------------------------------------------------------ rank identity
+def process_index() -> int:
+    """This process's rank.  Lazy: jax's distributed view when jax is
+    already imported (never imports it), else the launcher env, else 0.
+    """
+    if "jax" in sys.modules:
+        try:
+            return int(sys.modules["jax"].process_index())
+        except Exception:  # noqa: BLE001 — backend not initialized yet
+            pass
+    try:
+        return int(_environ.get("LGBM_TPU_PROCESS_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def process_count() -> int:
+    """World size, resolved like :func:`process_index`."""
+    if "jax" in sys.modules:
+        try:
+            return int(sys.modules["jax"].process_count())
+        except Exception:  # noqa: BLE001
+            pass
+    try:
+        return max(1, int(_environ.get("LGBM_TPU_NUM_PROCESSES", "1") or 1))
+    except ValueError:
+        return 1
+
+
+def _device_info() -> dict:
+    """Best-effort local device identity (never initializes a backend
+    the process didn't already use — the manifest lesson)."""
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        jax = sys.modules["jax"]
+        devs = jax.local_devices()
+        return {
+            "backend": devs[0].platform,
+            "kind": getattr(devs[0], "device_kind", None),
+            "local_count": len(devs),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {str(e)[:80]}"}
+
+
+# ------------------------------------------------------------ rank snapshot
+def rank_snapshot(tel: Optional[telemetry.Telemetry] = None,
+                  rank: Optional[int] = None,
+                  world: Optional[int] = None,
+                  extra: Optional[dict] = None) -> dict:
+    """One rank's full telemetry snapshot, stamped with its identity.
+    Reservoirs carry their raw sample windows (``include_samples``) so a
+    merge can recompute exact window quantiles instead of averaging
+    percentiles (which is wrong for any skewed distribution)."""
+    tel = tel or telemetry.get_telemetry()
+    return {
+        "schema": RANK_SCHEMA,
+        "process_index": process_index() if rank is None else int(rank),
+        "process_count": process_count() if world is None else int(world),
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "device": _device_info(),
+        "created_unix": round(time.time(), 3),
+        "telemetry": tel.snapshot(include_samples=True),
+        "extra": dict(extra or {}),
+    }
+
+
+def _skew(per_rank: Dict[int, float]) -> dict:
+    """Cross-rank skew of one named series: max−min and max/mean plus
+    WHICH rank sits at each extreme — the attribution half."""
+    ranks = sorted(per_rank)
+    vals = [per_rank[r] for r in ranks]
+    vmax, vmin = max(vals), min(vals)
+    mean = sum(vals) / len(vals)
+    return {
+        "per_rank": {str(r): round(per_rank[r], 6) for r in ranks},
+        "mean_s": round(mean, 6),
+        "max_s": round(vmax, 6),
+        "min_s": round(vmin, 6),
+        "max_minus_min_s": round(vmax - vmin, 6),
+        "max_over_mean": round(vmax / mean, 4) if mean > 0 else 0.0,
+        "max_rank": ranks[vals.index(vmax)],
+        "min_rank": ranks[vals.index(vmin)],
+        "reported": len(ranks),
+    }
+
+
+def merge_snapshots(snaps: Sequence[dict]) -> dict:
+    """Merge per-rank snapshots (:func:`rank_snapshot` shape) into ONE
+    cross-rank view.
+
+    * counters: exact sums (plain sum in rank order — the tier-1
+      contract is ``merged == sum(per-rank)`` to the bit);
+    * spans: total_s/count summed, min/max over ranks, plus
+      ``span_skew`` over per-rank total_s;
+    * reservoirs: sample windows concatenated in rank order and the
+      window quantiles recomputed exactly, plus ``reservoir_skew`` over
+      per-rank window means;
+    * histograms: bucket counts summed when bounds agree; a bounds
+      mismatch is RECORDED (``histogram_merge_conflicts``), never
+      silently resolved.
+    """
+    if not snaps:
+        raise ValueError("merge_snapshots: no snapshots to merge")
+    by_rank = sorted(snaps, key=lambda s: int(s.get("process_index", 0)))
+    ranks = [int(s.get("process_index", 0)) for s in by_rank]
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(f"merge_snapshots: duplicate ranks {ranks}")
+
+    counters: Dict[str, float] = {}
+    span_tot: Dict[str, dict] = {}
+    span_per_rank: Dict[str, Dict[int, float]] = {}
+    res_samples: Dict[str, List[float]] = {}
+    res_count: Dict[str, int] = {}
+    res_per_rank_mean: Dict[str, Dict[int, float]] = {}
+    hists: Dict[str, dict] = {}
+    hist_conflicts: List[str] = []
+
+    for s in by_rank:
+        r = int(s.get("process_index", 0))
+        t = s.get("telemetry") or {}
+        for k, v in (t.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, st in (t.get("spans") or {}).items():
+            tot = span_tot.setdefault(
+                k, {"total_s": 0.0, "count": 0,
+                    "min_s": float("inf"), "max_s": 0.0})
+            tot["total_s"] += float(st.get("total_s", 0.0))
+            tot["count"] += int(st.get("count", 0))
+            tot["min_s"] = min(tot["min_s"], float(st.get("min_s", 0.0)))
+            tot["max_s"] = max(tot["max_s"], float(st.get("max_s", 0.0)))
+            span_per_rank.setdefault(k, {})[r] = float(st.get("total_s", 0.0))
+        for k, rd in (t.get("reservoirs") or {}).items():
+            samples = [float(x) for x in (rd.get("samples") or [])]
+            res_samples.setdefault(k, []).extend(samples)
+            res_count[k] = res_count.get(k, 0) + int(rd.get("count", 0))
+            res_per_rank_mean.setdefault(k, {})[r] = float(
+                rd.get("mean_s", 0.0))
+        for k, hd in (t.get("histograms") or {}).items():
+            cur = hists.get(k)
+            if cur is None:
+                hists[k] = {"bounds": list(hd.get("bounds") or []),
+                            "counts": [int(c) for c in
+                                       (hd.get("counts") or [])],
+                            "count": int(hd.get("count", 0)),
+                            "sum": float(hd.get("sum", 0.0))}
+            elif cur["bounds"] != list(hd.get("bounds") or []):
+                if k not in hist_conflicts:
+                    hist_conflicts.append(k)
+            else:
+                cur["counts"] = [a + int(b) for a, b in
+                                 zip(cur["counts"], hd.get("counts") or [])]
+                cur["count"] += int(hd.get("count", 0))
+                cur["sum"] += float(hd.get("sum", 0.0))
+
+    spans = {}
+    for k, tot in span_tot.items():
+        spans[k] = {
+            "total_s": round(tot["total_s"], 6),
+            "count": tot["count"],
+            "min_s": round(tot["min_s"], 6)
+            if tot["min_s"] != float("inf") else 0.0,
+            "max_s": round(tot["max_s"], 6),
+        }
+    reservoirs = {}
+    for k, samples in res_samples.items():
+        window = len(samples)
+        srt = sorted(samples)
+
+        def _pct(p: float) -> float:
+            if not srt:
+                return 0.0
+            i = max(0, min(len(srt) - 1,
+                           int(round(p / 100.0 * (len(srt) - 1)))))
+            return srt[i]
+
+        reservoirs[k] = {
+            "count": res_count.get(k, 0),
+            "window": window,
+            "mean_s": round(sum(samples) / window, 6) if window else 0.0,
+            "p50_s": round(_pct(50), 6),
+            "p99_s": round(_pct(99), 6),
+            "max_s": round(srt[-1], 6) if srt else 0.0,
+        }
+
+    return {
+        "schema": MERGED_SCHEMA,
+        "world": len(by_rank),
+        "ranks": ranks,
+        "counters": counters,
+        "spans": spans,
+        "span_skew": {k: _skew(v) for k, v in span_per_rank.items()
+                      if len(v) > 1},
+        "reservoirs": reservoirs,
+        "reservoir_skew": {k: _skew(v)
+                           for k, v in res_per_rank_mean.items()
+                           if len(v) > 1},
+        "histograms": hists,
+        "histogram_merge_conflicts": hist_conflicts,
+    }
+
+
+# straggler attribution reads these series: barrier wait per rank.  The
+# rank that waited LEAST arrived LAST — everyone else's wait is the time
+# they spent at the barrier waiting for it.
+_WAIT_SUFFIX = ".wait_s"
+# a skew below this floor is scheduling noise, not a straggler
+STRAGGLER_FLOOR_S = 0.005
+
+
+def attribute_stragglers(merged: dict,
+                         floor_s: float = STRAGGLER_FLOOR_S) -> List[dict]:
+    """Scan a merged snapshot's barrier-wait skews and name the
+    straggling rank per collective site.  Returns
+    ``[{site, straggler_rank, wait_skew_s, max_over_mean}]``, worst
+    first; empty when no wait series shows skew above ``floor_s``."""
+    out = []
+    for name, sk in (merged.get("reservoir_skew") or {}).items():
+        if not name.endswith(_WAIT_SUFFIX):
+            continue
+        if sk["max_minus_min_s"] < floor_s:
+            continue
+        site = name[len("collective."):-len(_WAIT_SUFFIX)] \
+            if name.startswith("collective.") else name
+        out.append({
+            "site": site,
+            "straggler_rank": sk["min_rank"],
+            "wait_skew_s": sk["max_minus_min_s"],
+            "max_over_mean": sk["max_over_mean"],
+        })
+    out.sort(key=lambda d: -d["wait_skew_s"])
+    return out
+
+
+# ---------------------------------------------------------------- exchange
+def exchange_dir_for(artifact_path: str) -> str:
+    """Canonical rank-snapshot exchange directory for a run artifact:
+    the env override wins, else a ``<artifact>.rankobs`` sibling."""
+    env = _environ.get("LGBM_TPU_RANK_OBS_DIR", "")
+    if env:
+        return env
+    return os.path.abspath(artifact_path) + ".rankobs"
+
+
+def _rank_file(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank_{rank}.json")
+
+
+def write_rank_snapshot(directory: str,
+                        snap: Optional[dict] = None) -> str:
+    """Atomically publish this rank's snapshot into the exchange dir."""
+    from ..resilience.atomic import atomic_write_json
+
+    snap = snap or rank_snapshot()
+    os.makedirs(directory, exist_ok=True)
+    path = _rank_file(directory, int(snap["process_index"]))
+    atomic_write_json(path, snap)
+    return path
+
+
+def gather_rank_snapshots(directory: str, world: int,
+                          timeout_s: float = 120.0,
+                          poll_s: float = 0.1) -> List[dict]:
+    """Rank 0's half of the exchange: poll until all ``world`` files are
+    present (atomic writes mean a present file is a complete file),
+    then load them sorted by rank.  Raises ``TimeoutError`` naming the
+    MISSING ranks — the closest thing a dead peer leaves to a name."""
+    deadline = time.monotonic() + timeout_s
+    want = {r: _rank_file(directory, r) for r in range(world)}
+    while True:
+        missing = [r for r, p in want.items() if not os.path.exists(p)]
+        if not missing:
+            break
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"rank-snapshot exchange: ranks {missing} never published "
+                f"into {directory} within {timeout_s:.0f}s — those "
+                "processes likely died; check their logs/flight recorders")
+        time.sleep(poll_s)
+    snaps = []
+    for r in range(world):
+        with open(want[r]) as fh:
+            snaps.append(json.load(fh))
+    return snaps
+
+
+def exchange_snapshots(directory: str, timeout_s: float = 120.0,
+                       extra: Optional[dict] = None) -> Optional[dict]:
+    """End-of-run snapshot exchange: every rank publishes, rank 0
+    gathers and merges.  Returns the merged snapshot on rank 0, None on
+    other ranks.  Single-process worlds skip the file round-trip and
+    merge the local snapshot directly (same output shape)."""
+    world = process_count()
+    rank = process_index()
+    snap = rank_snapshot(extra=extra)
+    if world <= 1:
+        return merge_snapshots([snap])
+    write_rank_snapshot(directory, snap)
+    if rank != 0:
+        return None
+    return merge_snapshots(
+        gather_rank_snapshots(directory, world, timeout_s=timeout_s))
+
+
+def ranks_section(snaps: Sequence[dict]) -> List[dict]:
+    """The manifest ``ranks[]`` entries: per-rank identity + the
+    load-bearing numbers (compiles, span seconds, collective wait/
+    transfer, counters) WITHOUT the raw sample windows — the manifest
+    stays readable; the full snapshots stay in the exchange dir."""
+    out = []
+    for s in sorted(snaps, key=lambda s: int(s.get("process_index", 0))):
+        t = s.get("telemetry") or {}
+        res = {k: {kk: v[kk] for kk in ("count", "mean_s", "p50_s", "p99_s")
+                   if kk in v}
+               for k, v in (t.get("reservoirs") or {}).items()}
+        out.append({
+            "process_index": int(s.get("process_index", 0)),
+            "pid": s.get("pid"),
+            "host": s.get("host"),
+            "device": s.get("device") or {},
+            "counters": dict(t.get("counters") or {}),
+            "spans": dict(t.get("spans") or {}),
+            "reservoirs": res,
+        })
+    return out
+
+
+# ------------------------------------------------------ collective tracing
+def record_collective_site(site: str, op: str, nbytes: int) -> None:
+    """Trace-time census of an in-program collective site (called from
+    INSIDE a traced body, so it counts once per retrace — pair it with
+    the ``dp_grow_traces`` counter to normalize).  Makes the
+    3-collectives/split contract checkable per-op: each site shows up
+    as ``collective_site.<site>.<op>`` with its payload bytes."""
+    telemetry.count_many({
+        f"collective_site.{site}.{op}": 1,
+        f"collective_site_bytes.{site}": int(nbytes),
+    })
+
+
+def traced_collective(fn: Callable, *, op: str, label: str,
+                      payload_bytes: int = 0,
+                      barrier_fn: Optional[Callable] = None,
+                      deadline_s: float = 0.0,
+                      retries: int = 2,
+                      rank: Optional[int] = None,
+                      tel: Optional[telemetry.Telemetry] = None):
+    """Run a host-blocking collective with per-site tracing.
+
+    Timing is split in two when ``barrier_fn`` is given (and the
+    ``LGBM_TPU_COLLECTIVE_TRACE`` knob is on): the barrier's wall time
+    is pure straggler wait (every rank must arrive before any passes),
+    the remainder is the payload transfer.  Both feed labeled
+    reservoirs (``collective.<label>.wait_s`` / ``.transfer_s``) — the
+    series :func:`merge_snapshots` computes cross-rank skew over and
+    :func:`attribute_stragglers` names the slow rank from.
+
+    The call itself rides :func:`resilience.retry.guarded_collective`
+    (chaos injection point, pre-dispatch transient retry attributed to
+    ``label``, optional deadline).  ``rank`` overrides the fault
+    injection's rank match (simulated worlds in tests/chaos)."""
+    from ..resilience import faults
+    from ..resilience.retry import call_with_deadline, guarded_collective
+
+    tel = tel or telemetry.get_telemetry()
+    faults.maybe_delay_collective(rank=rank)
+    wait_s = 0.0
+    t0 = time.perf_counter()
+    if barrier_fn is not None and COLLECTIVE_TRACE:
+        # the barrier is itself a collective: a dead peer would hang it
+        # forever, so it runs under the SAME deadline as the payload —
+        # tracing must never weaken the hang protection it instruments
+        call_with_deadline(barrier_fn, deadline_s,
+                           what=f"{label} barrier")
+        wait_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    out = guarded_collective(fn, deadline_s=deadline_s, label=label,
+                             retries=retries)
+    transfer_s = time.perf_counter() - t1
+    tel.count_many({
+        "collective_ops": 1,
+        f"collective_ops.op.{op}": 1,
+        "collective_bytes": int(payload_bytes),
+        f"collective_bytes.op.{op}": int(payload_bytes),
+    })
+    tel.record_samples({
+        f"collective.{label}.wait_s": wait_s,
+        f"collective.{label}.transfer_s": transfer_s,
+    })
+    return out
+
+
+# --------------------------------------------------------- desync sentinel
+class DesyncError(RuntimeError):
+    """Two ranks disagree on what iteration/model they are training.
+    Raised the iteration the divergence is observed, NAMING the rank —
+    the alternative is bitwise-divergent models discovered post-hoc."""
+
+
+def state_fingerprint(step: int, config_fp: int, *payloads) -> int:
+    """Cheap int31 fingerprint of the per-iteration state: the step,
+    the structural-config crc, and any host bytes the caller wants
+    covered (the grown tree's arrays — crc32 of a few KB per tree).
+    Masked to int31 so the int32 collective transport is lossless."""
+    h = zlib.crc32(f"{step}|{config_fp}".encode())
+    for p in payloads:
+        if p is None:
+            continue
+        if isinstance(p, (bytes, bytearray)):
+            h = zlib.crc32(p, h)
+        else:
+            h = zlib.crc32(repr(p).encode(), h)
+    return h & 0x7FFFFFFF
+
+
+def config_crc(obj) -> int:
+    """Structural-config half of the fingerprint (stable across ranks
+    by construction — the config fingerprint multihost sync verified)."""
+    try:
+        blob = repr(sorted(vars(obj).items())) if hasattr(obj, "__dict__") \
+            else repr(obj)
+    except Exception:  # noqa: BLE001 — any stable repr will do
+        blob = repr(obj)
+    return zlib.crc32(blob.encode()) & 0x7FFFFFFF
+
+
+class DesyncSentinel:
+    """Cross-rank agreement check piggybacked on a per-iteration sync
+    point.
+
+    Each rank contributes ``[step, fingerprint, rank]`` (int32) to one
+    small allgather; every rank then verifies all rows agree on (step,
+    fingerprint).  A mismatch identifies the diverging rank(s) by
+    majority (the minority rows are the divergents; on a tie the
+    highest-rank minority is named) and raises :class:`DesyncError`
+    within the iteration, after recording a flight-recorder event and
+    dumping the ring (tail = ``desync_detected``).
+
+    ``gather_fn(row) -> [world, 3]`` defaults to
+    ``multihost_utils.process_allgather`` via :func:`traced_collective`
+    (label ``desync_sentinel``); tests and chaos inject a fake gather
+    to fabricate peer worlds in one process.
+    """
+
+    def __init__(self, world: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 gather_fn: Optional[Callable] = None,
+                 check_every: int = DESYNC_CHECK_EVERY,
+                 deadline_s: float = 0.0) -> None:
+        self.world = process_count() if world is None else int(world)
+        self.rank = process_index() if rank is None else int(rank)
+        self.check_every = int(check_every)
+        self.deadline_s = deadline_s
+        self._gather = gather_fn
+
+    def local_row(self, step: int, fp: int):
+        """This rank's sentinel row, with the ``desync_step`` chaos
+        fault applied (a matching rank perturbs its fingerprint ONCE —
+        the lab analog of a rank that silently took a different
+        branch)."""
+        import numpy as np
+
+        from ..resilience import faults
+
+        if faults.maybe_desync_step(rank=self.rank):
+            fp = (fp + 1) & 0x7FFFFFFF
+        return np.asarray([int(step) & 0x7FFFFFFF, int(fp), self.rank],
+                          np.int32)
+
+    def _default_gather(self, row):
+        from jax.experimental import multihost_utils
+
+        return traced_collective(
+            lambda: multihost_utils.process_allgather(row),
+            op="all-gather", label="desync_sentinel",
+            payload_bytes=int(row.size) * 4 * self.world,
+            barrier_fn=lambda: multihost_utils.sync_global_devices(
+                "lgbm_desync_sentinel"),
+            deadline_s=self.deadline_s)
+
+    def should_check(self, step: int) -> bool:
+        return (self.world > 1 and self.check_every > 0
+                and step % self.check_every == 0)
+
+    def verify(self, step: int, fp: int) -> None:
+        """Exchange and compare.  No-op in single-rank worlds or on
+        off-cadence steps."""
+        if not self.should_check(step):
+            return
+        import numpy as np
+
+        row = self.local_row(step, fp)
+        gather = self._gather or self._default_gather
+        g = np.asarray(gather(row)).reshape(-1, 3)
+        telemetry.count("desync_checks")
+        pairs = [(int(r[0]), int(r[1])) for r in g]
+        if len(set(pairs)) <= 1:
+            return
+        # majority vote: the modal (step, fp) is the world's consensus;
+        # every minority row is a divergent rank
+        from collections import Counter
+
+        consensus, _ = Counter(pairs).most_common(1)[0]
+        divergent = sorted(int(g[i][2]) for i, p in enumerate(pairs)
+                           if p != consensus)
+        detail = {int(r[2]): {"step": int(r[0]), "fingerprint": int(r[1])}
+                  for r in g}
+        telemetry.count("desync_detected")
+        flightrec.record("desync_detected", iteration=int(step),
+                         divergent_ranks=divergent,
+                         consensus_step=consensus[0],
+                         consensus_fingerprint=consensus[1])
+        flightrec.dump(reason="desync")
+        raise DesyncError(
+            f"cross-rank desync at iteration {int(step)}: rank(s) "
+            f"{divergent} disagree with the {len(pairs) - len(divergent)}"
+            f"-rank consensus (step={consensus[0]}, "
+            f"fingerprint={consensus[1]}); per-rank view: {detail}. "
+            "This world is no longer training one model — stop all "
+            "ranks and resume from the last checkpoint.")
+
+
+# ----------------------------------------------------- multichip artifact
+def multichip_artifact(merged: dict, snaps: Sequence[dict],
+                       result: Optional[dict] = None,
+                       extra: Optional[dict] = None) -> dict:
+    """The committable multi-chip evidence blob
+    (``lightgbm-tpu/multichip-bench/v1``): merged telemetry + per-rank
+    breakdown + skew + straggler attribution, benchdiff-comparable."""
+    devices = {}
+    for s in snaps:
+        d = s.get("device") or {}
+        if d.get("backend"):
+            devices[d["backend"]] = devices.get(d["backend"], 0) \
+                + int(d.get("local_count") or 1)
+    return {
+        "schema": MULTICHIP_SCHEMA,
+        "world": merged.get("world"),
+        "devices": devices,
+        "result": dict(result or {}),
+        "ranks": ranks_section(snaps),
+        "merged": {k: merged[k] for k in
+                   ("counters", "spans", "reservoirs", "histograms")
+                   if k in merged},
+        "skew": {"spans": merged.get("span_skew") or {},
+                 "reservoirs": merged.get("reservoir_skew") or {}},
+        "stragglers": attribute_stragglers(merged),
+        "extra": dict(extra or {}),
+        "created_unix": round(time.time(), 3),
+    }
+
+
+def _fmt_cell(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)
+
+
+def render_rank_table(merged: dict, ranks: Sequence[dict],
+                      counters: Sequence[str] = (
+                          "backend_compiles", "dp_grow_traces",
+                          "collective_ops", "desync_checks"),
+                      span_prefixes: Sequence[str] = ("dist.grow",),
+                      ) -> List[str]:
+    """Human-readable per-rank table + skew tail (shared by
+    ``tools/rank_report.py`` and the dryrun MULTICHIP tail)."""
+    span_names = sorted(
+        n for n in (merged.get("spans") or {})
+        if any(n.startswith(p) for p in span_prefixes))
+    wait_names = sorted(
+        n for n in (merged.get("reservoirs") or {})
+        if n.startswith("collective.") and n.endswith(".wait_s"))
+    head = (["rank", "device"] + list(counters)
+            + [f"{n} s" for n in span_names]
+            + [f"{n[len('collective.'):-len('.wait_s')]} wait-mean s"
+               for n in wait_names])
+    rows = [head]
+    for r in ranks:
+        dev = r.get("device") or {}
+        cells = [str(r.get("process_index")),
+                 f"{dev.get('backend', '?')}x{dev.get('local_count', '?')}"]
+        cnt = r.get("counters") or {}
+        cells += [_fmt_cell(cnt.get(c, 0)) for c in counters]
+        sp = r.get("spans") or {}
+        cells += [_fmt_cell((sp.get(n) or {}).get("total_s", 0.0))
+                  for n in span_names]
+        res = r.get("reservoirs") or {}
+        cells += [_fmt_cell((res.get(n) or {}).get("mean_s", 0.0))
+                  for n in wait_names]
+        rows.append(cells)
+    widths = [max(len(row[i]) for row in rows) for i in range(len(head))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+             for row in rows]
+    for sk_name, sk in sorted((merged.get("span_skew") or {}).items()):
+        if any(sk_name.startswith(p) for p in span_prefixes):
+            lines.append(
+                f"skew {sk_name}: max-min {sk['max_minus_min_s']:.4f}s "
+                f"(max r{sk['max_rank']} / min r{sk['min_rank']}, "
+                f"max/mean {sk['max_over_mean']:.2f})")
+    for s in attribute_stragglers(merged):
+        lines.append(
+            f"straggler {s['site']}: rank {s['straggler_rank']} "
+            f"(wait skew {s['wait_skew_s']:.4f}s, max/mean "
+            f"{s['max_over_mean']:.2f})")
+    return lines
+
+
+def merged_manifest_extra(merged: dict) -> dict:
+    """The slim merged-telemetry block a RunManifest carries under
+    ``extra`` (skew + stragglers + merged counters; per-rank detail
+    lives in ``ranks[]``)."""
+    return {
+        "merged_counters": dict(merged.get("counters") or {}),
+        "span_skew": merged.get("span_skew") or {},
+        "reservoir_skew": merged.get("reservoir_skew") or {},
+        "stragglers": attribute_stragglers(merged),
+        "world": merged.get("world"),
+    }
+
+
+def artifact_sha(path: str) -> Optional[str]:
+    """sha256 of an artifact file (rank-report provenance lines)."""
+    try:
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()[:16]
+    except OSError:
+        return None

@@ -6,7 +6,7 @@ This module keeps a lock-cheap ring of the most recent events —
 dispatches, hot-swaps, checkpoint writes, injected faults, guard trips,
 signals — and, when something terminal happens, dumps the ring
 atomically (``resilience.atomic``, with a ``.sha256`` sidecar) to
-``<dir>/flightrec_<pid>.json``.  The dump's TAIL is the triggering
+``<dir>/flightrec_r<rank>_<pid>.json``.  The dump's TAIL is the triggering
 event: the writer records the trigger and then dumps, so a post-mortem
 reads the file backwards from the cause.
 
@@ -64,8 +64,31 @@ _EVENTS: Deque[dict] = collections.deque(maxlen=max(1, _ENV_CAP))
 # seq via itertools.count: next() is atomic under the GIL, so ids stay
 # unique and contiguous across threads
 _SEQ = itertools.count()
-_STATE: Dict[str, object] = {"dir": _ENV_DIR}
+_STATE: Dict[str, object] = {"dir": _ENV_DIR, "rank": None}
 _DUMP_LOCK = threading.Lock()
+
+
+def set_rank(rank: Optional[int]) -> None:
+    """Explicit rank override for the dump filename (tests/chaos
+    simulate multi-rank worlds in one process).  ``None`` restores
+    lazy auto-detection."""
+    _STATE["rank"] = rank
+
+
+def _resolve_rank() -> int:
+    """The rank baked into the dump filename.  The explicit override
+    wins; otherwise delegate to the ONE lazy resolution chain in
+    obs/dist.py (jax-if-already-imported -> launcher env -> 0).
+    Guarded: this can run in a signal handler on the way down, and a
+    rank-resolution failure must never cost the post-mortem."""
+    if _STATE.get("rank") is not None:
+        return int(_STATE["rank"])  # type: ignore[arg-type]
+    try:
+        from .dist import process_index
+
+        return process_index()
+    except Exception:  # noqa: BLE001
+        return 0
 
 
 def record(kind: str, **fields) -> None:
@@ -150,15 +173,23 @@ def reset() -> None:
 
 
 def dump_path(directory: Optional[str] = None) -> Optional[str]:
+    """Rank-tagged dump location: ``flightrec_r<rank>_<pid>.json``.
+    On a multi-rank run every rank dumps into the SAME directory
+    (shared filesystem or a gathered scratch dir), so the filename must
+    carry the rank — pids alone can collide across hosts, and a
+    post-mortem that cannot say which rank's ring it reads is useless
+    for desync/straggler attribution."""
     d = directory or dump_dir()
     if not d:
         return None
-    return os.path.join(d, f"flightrec_{os.getpid()}.json")
+    return os.path.join(
+        d, f"flightrec_r{_resolve_rank()}_{os.getpid()}.json")
 
 
 def dump(reason: str = "", directory: Optional[str] = None
          ) -> Optional[str]:
-    """Write the ring to ``<dir>/flightrec_<pid>.json`` atomically with
+    """Write the ring to ``<dir>/flightrec_r<rank>_<pid>.json``
+    atomically with
     a checksum sidecar.  Returns the path, or None when no directory is
     configured.  NEVER raises — this runs on the way down (signal
     handlers, terminal excepts), and the dump failing must not mask the
@@ -171,6 +202,7 @@ def dump(reason: str = "", directory: Optional[str] = None
             payload = {
                 "schema": SCHEMA,
                 "pid": os.getpid(),
+                "rank": _resolve_rank(),
                 "created_unix": round(time.time(), 3),
                 "reason": reason,
                 "dropped": dropped(),

@@ -143,6 +143,12 @@ class RunManifest:
     per_tree: dict
     result: dict
     extra: dict = dataclasses.field(default_factory=dict)
+    # multi-rank runs (obs/dist.py): rank 0 writes the ONE manifest,
+    # carrying every rank's identity + load-bearing numbers (device,
+    # compiles, span seconds, collective wait/transfer).  Empty on
+    # single-process runs; optional in v1 (validate does not require
+    # it), so every existing manifest still loads.
+    ranks: list = dataclasses.field(default_factory=list)
     schema: str = SCHEMA
 
     @classmethod
@@ -151,7 +157,8 @@ class RunManifest:
                 phases: Optional[dict] = None,
                 warmup: Optional[dict] = None,
                 per_tree_reservoir: str = "tree_s",
-                extra: Optional[dict] = None) -> "RunManifest":
+                extra: Optional[dict] = None,
+                ranks: Optional[list] = None) -> "RunManifest":
         """Gather everything the process knows right now.  ``entry`` is
         the entry point name ("bench.py", "cli.train", "northstar")."""
         tel = get_telemetry()
@@ -170,6 +177,7 @@ class RunManifest:
             per_tree=res.as_dict() if res is not None else {},
             result=dict(result or {}),
             extra=dict(extra or {}),
+            ranks=list(ranks or []),
         )
 
     def to_dict(self) -> dict:

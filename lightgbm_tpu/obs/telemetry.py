@@ -146,10 +146,10 @@ class Reservoir:
         c._n = self._n
         return c
 
-    def as_dict(self) -> dict:
+    def as_dict(self, include_samples: bool = False) -> dict:
         window = len(self._buf)
         mean = sum(self._buf) / window if window else 0.0
-        return {
+        out = {
             "count": self._n,
             "window": window,
             "mean_s": round(mean, 6),
@@ -157,6 +157,15 @@ class Reservoir:
             "p99_s": round(self.percentile(99), 6),
             "max_s": round(max(self._buf), 6) if window else 0.0,
         }
+        if include_samples:
+            # the raw window, in insertion order: cross-rank merging
+            # (obs/dist.py) concatenates windows and recomputes exact
+            # quantiles — averaging per-rank percentiles would be wrong
+            # for any skewed distribution
+            start = self._n % self.cap if self._n > self.cap else 0
+            ordered = self._buf[start:] + self._buf[:start]
+            out["samples"] = [round(v, 6) for v in ordered]
+        return out
 
 
 class Histogram:
@@ -368,7 +377,8 @@ class Telemetry:
     def histogram(self, name: str) -> Optional[Histogram]:
         return self._histograms.get(name)
 
-    def snapshot(self, include_compiles: bool = True) -> dict:
+    def snapshot(self, include_compiles: bool = True,
+                 include_samples: bool = False) -> dict:
         """ONE consistent cut of everything, as plain JSON-able dicts:
         the store lock is held across the whole copy and every writer
         takes the same lock, so no snapshot can observe one counter of
@@ -389,7 +399,8 @@ class Telemetry:
             # scrape can't stall every request-path writer meanwhile
             res_clones = {k: v.clone() for k, v in self._reservoirs.items()}
             histograms = {k: v.as_dict() for k, v in self._histograms.items()}
-        reservoirs = {k: v.as_dict() for k, v in res_clones.items()}
+        reservoirs = {k: v.as_dict(include_samples=include_samples)
+                      for k, v in res_clones.items()}
         if include_compiles and "jax" in sys.modules:
             try:
                 from lightgbm_tpu.analysis.recompile import (
@@ -549,7 +560,15 @@ def record_collectives(tag: str, compiled) -> dict:
     .compile()``) and fold them into the telemetry counters
     (``collective_ops`` / ``collective_bytes``).  Returns the stats."""
     stats = collective_stats(compiled.as_text())
-    _TELEMETRY.count("collective_ops", stats["total"])
-    _TELEMETRY.count("collective_bytes", stats["payload_bytes"])
-    _TELEMETRY.count(f"collective_ops.{tag}", stats["total"])
+    adds = {
+        "collective_ops": stats["total"],
+        "collective_bytes": stats["payload_bytes"],
+        f"collective_ops.{tag}": stats["total"],
+    }
+    # per-op-kind fold (obs/dist.py convention: the 3-collectives/split
+    # contract is checkable per-op, not just as a total)
+    for op, c in stats["by_op"].items():
+        adds[f"collective_ops.op.{op}"] = \
+            adds.get(f"collective_ops.op.{op}", 0) + c
+    _TELEMETRY.count_many(adds)
     return stats

@@ -54,6 +54,7 @@ from ..learners.depthwise import grow_tree_depthwise
 from ..learners.hybrid import HYBRID_STOP_FACTOR
 from ..learners.serial import grow_tree
 from ..obs import telemetry
+from ..obs.dist import record_collective_site
 from ..ops.histogram import histogram_by_leaf, histogram_feature_major
 from ..ops.split import SplitResult, find_best_split
 from .mesh import ROW_AXIS, row_padded_grower
@@ -120,8 +121,15 @@ def data_parallel_sharded(
                 # allreduce's bytes; each device keeps [L, F/D, B, 3]
                 hl = local_level_hist(bt, lid, g, h, m, num_leaves)
                 hl = jnp.pad(hl, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                return jax.lax.psum_scatter(hl, axis, scatter_dimension=1,
-                                            tiled=True)
+                out = jax.lax.psum_scatter(hl, axis, scatter_dimension=1,
+                                           tiled=True)
+                # trace-time site census (obs/dist.py): op identity +
+                # result bytes, once per retrace — the per-op half of
+                # the collectives-per-split contract
+                record_collective_site(
+                    "dp.level_hist_reduce_scatter", "reduce-scatter",
+                    out.size * out.dtype.itemsize)
+                return out
 
             def search_leaves_fn(hist, sg, sh, c, can, _fm, _nb, _ic, prm):
                 # per-leaf shard search + ONE packed [D, L, 11] combine
@@ -136,6 +144,9 @@ def data_parallel_sharded(
                 )
                 r = offset_feature(r)
                 g2 = jax.lax.all_gather(pack_split(r), axis)  # [D, L, 11]
+                record_collective_site(
+                    "dp.split_allgather_leaves", "all-gather",
+                    g2.size * g2.dtype.itemsize)
                 return combine_gathered_split_infos(unpack_split(g2))
 
             if growth == "depthwise":
@@ -153,8 +164,12 @@ def data_parallel_sharded(
             # 127-157)
             hp = hist_local(bins_arg, g, h, m)
             hp = jnp.pad(hp, ((0, pad), (0, 0), (0, 0)))
-            return jax.lax.psum_scatter(hp, axis, scatter_dimension=0,
-                                        tiled=True)
+            out = jax.lax.psum_scatter(hp, axis, scatter_dimension=0,
+                                       tiled=True)
+            record_collective_site("dp.hist_reduce_scatter",
+                                   "reduce-scatter",
+                                   out.size * out.dtype.itemsize)
+            return out
 
         def search_local(hist, sg, sh, c, can, prm):
             r = find_best_split(
@@ -169,7 +184,8 @@ def data_parallel_sharded(
             # root search: one shard-best SplitInfo per device, one
             # (packed) all_gather + deterministic max
             return gather_and_combine(
-                search_local(hist, sg, sh, c, can, prm), axis
+                search_local(hist, sg, sh, c, can, prm), axis,
+                site="dp.root_split_allgather",
             )
 
         # the per-split shard search: ONE Pallas launch on TPU (the
@@ -199,6 +215,8 @@ def data_parallel_sharded(
                 rr = search_local(hr, rsg, rsh, rc, can, prm)
             both = jnp.stack([pack_split(rl), pack_split(rr)])  # [2, 11]
             g = jax.lax.all_gather(both, axis)  # [D, 2, 11]
+            record_collective_site("dp.split_allgather", "all-gather",
+                                   g.size * g.dtype.itemsize)
             w = combine_gathered_split_infos(unpack_split(g))
             return (SplitResult(*[f[0] for f in w]),
                     SplitResult(*[f[1] for f in w]))
@@ -208,6 +226,9 @@ def data_parallel_sharded(
             # two local counts, then global sums (smaller-child choice)
             # and cross-shard maxes (tier gates) are local reductions
             g = jax.lax.all_gather(jnp.stack([nl, nr]), axis)  # [D, 2]
+            record_collective_site("dp.child_counts_allgather",
+                                   "all-gather",
+                                   g.size * g.dtype.itemsize)
             s = jnp.sum(g, axis=0)
             m = jnp.max(g, axis=0)
             return s[0], s[1], m[0], m[1]

@@ -186,17 +186,25 @@ def sync_config_across_processes(cfg) -> None:
         [float(getattr(cfg, k, 1.0)) for k in frac_names], np.float64
     )
     payload = np.concatenate([seeds, fracs.view(np.int32)])  # [3 + 4] i32
-    # guarded collective (resilience/retry.py): a peer that died before
-    # joining this allgather would otherwise hang EVERY rank forever.
-    # collective_deadline_s (or LGBM_TPU_COLLECTIVE_DEADLINE_S) bounds
-    # the wait and fails loudly; transient UNAVAILABLE errors retry with
-    # backoff (and the fail_collective_once chaos fault injects here).
-    from ..resilience.retry import collective_deadline_s, guarded_collective
+    # traced + guarded collective (obs/dist.py over resilience/retry.py):
+    # a peer that died before joining this allgather would otherwise hang
+    # EVERY rank forever — collective_deadline_s (or
+    # LGBM_TPU_COLLECTIVE_DEADLINE_S) bounds the wait and fails loudly,
+    # transient UNAVAILABLE errors retry with backoff attributed to this
+    # site (and the fail_collective_once chaos fault injects here).  The
+    # tracing wrapper splits barrier wait (straggler time) from the
+    # transfer and feeds the per-op collective counters.
+    from ..obs import dist
+    from ..resilience.retry import collective_deadline_s
 
-    gathered = guarded_collective(
+    world = jax.process_count()
+    gathered = dist.traced_collective(
         lambda: multihost_utils.process_allgather(payload),
-        deadline_s=collective_deadline_s(cfg),
-        label="config sync allgather")  # [P, 7] i32
+        op="all-gather", label="config_sync",
+        payload_bytes=int(payload.size) * 4 * world,
+        barrier_fn=lambda: multihost_utils.sync_global_devices(
+            "lgbm_config_sync"),
+        deadline_s=collective_deadline_s(cfg))  # [P, 7] i32
     gathered = np.ascontiguousarray(np.asarray(gathered))
     seed_min = gathered[:, :3].min(axis=0)
     frac_all = gathered[:, 3:].view(np.float64)  # [P, 2]
@@ -222,7 +230,11 @@ def sync_config_across_processes(cfg) -> None:
     )
     # crc32 is uint32; mask to int31 so the int32 transport is lossless
     fp = np.asarray([zlib.crc32(fp_src.encode()) & 0x7FFFFFFF], np.int32)
-    fps = np.asarray(multihost_utils.process_allgather(fp)).ravel()
+    fps = np.asarray(dist.traced_collective(
+        lambda: multihost_utils.process_allgather(fp),
+        op="all-gather", label="config_fingerprint",
+        payload_bytes=4 * world,
+        deadline_s=collective_deadline_s(cfg))).ravel()
     if len(set(int(x) for x in fps)) > 1:
         Log.fatal(
             "training config differs across processes "
@@ -235,6 +247,7 @@ def make_multihost_data_parallel_grower(
     mesh, num_bins: int, max_leaves: int, axis: str = ROW_AXIS,
     growth: str = "leafwise", sorted_hist: bool = False,
     hist_pool: int = 0, record: bool = True,
+    collective_deadline: Optional[float] = None,
 ):
     """Data-parallel grower across processes: each process feeds its
     LOCAL row partition (the per-rank ingest split, io/distributed.py);
@@ -246,8 +259,30 @@ def make_multihost_data_parallel_grower(
     of LOCAL rows, padded here to a multiple of the local device count
     with bag_mask-0 rows.  Returns the (replicated) tree as host numpy
     and this process's local leaf partition.
+
+    Observability (obs/dist.py): each call times its dispatch and its
+    host fetch as ``dist.grow.dispatch`` / ``dist.grow.fetch`` spans
+    (host-wall — the fetch span ends AFTER the np.asarray sync, so it
+    is real device+transfer time; the dispatch span is trace+enqueue
+    wall), and — in a >1-process world — piggybacks a desync sentinel
+    on the fetch sync point: a cheap int32[3] fingerprint allgather of
+    (step, crc32 of the grown tree's bytes).  Ranks whose trees diverge
+    are NAMED within the iteration (`DesyncError`) instead of shipping
+    bitwise-divergent models.  ``LGBM_TPU_DESYNC_CHECK=0`` disables,
+    ``=N`` checks every N trees.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..obs import dist, telemetry
+    from ..resilience.retry import collective_deadline_s
+
+    # caller passes the config's deadline (gbdt does); None falls back
+    # to the env override alone
+    sentinel = dist.DesyncSentinel(
+        deadline_s=collective_deadline_s(None)
+        if collective_deadline is None else collective_deadline)
+    step_box = [0]  # grow() calls on this rank (the boosting iteration)
+    cfg_crc_box = [None]  # config half of the sentinel fingerprint
 
     sharded = jax.jit(
         data_parallel_sharded(
@@ -259,37 +294,58 @@ def make_multihost_data_parallel_grower(
     row_s = NamedSharding(mesh, P(axis))
 
     def grow(bins_T, grad, hess, bag_mask, fmask, nbpf, is_cat, params):
-        bins_T = np.asarray(bins_T)
-        grad = np.asarray(grad)
-        hess = np.asarray(hess)
-        bag_mask = np.asarray(bag_mask)
-        n_local = bins_T.shape[1]
-        pad = (-n_local) % jax.local_device_count()
-        if pad:
-            bins_T = np.pad(bins_T, ((0, 0), (0, pad)))
-            grad = np.pad(grad, (0, pad))
-            hess = np.pad(hess, (0, pad))
-            bag_mask = np.pad(bag_mask, (0, pad))  # invisible rows
+        with telemetry.span("dist.grow.dispatch"):
+            bins_T = np.asarray(bins_T)
+            grad = np.asarray(grad)
+            hess = np.asarray(hess)
+            bag_mask = np.asarray(bag_mask)
+            n_local = bins_T.shape[1]
+            pad = (-n_local) % jax.local_device_count()
+            if pad:
+                bins_T = np.pad(bins_T, ((0, 0), (0, pad)))
+                grad = np.pad(grad, (0, pad))
+                hess = np.pad(hess, (0, pad))
+                bag_mask = np.pad(bag_mask, (0, pad))  # invisible rows
 
-        mk = jax.make_array_from_process_local_data
-        g_bins = mk(col_s, bins_T)
-        g_grad = mk(row_s, grad)
-        g_hess = mk(row_s, hess)
-        g_bag = mk(row_s, bag_mask)
-        # replicated small inputs go in as host numpy (identical on every
-        # process; jit replicates them without communication)
-        tree, leaf_id = sharded(
-            g_bins, g_grad, g_hess, g_bag,
-            np.asarray(fmask), np.asarray(nbpf), np.asarray(is_cat),
-            jax.tree.map(np.asarray, params),
-        )
-        # tree is replicated -> each process holds a full copy
-        tree = jax.tree.map(lambda a: np.asarray(a.addressable_data(0)), tree)
-        # leaf_id is row-sharded; stitch this process's shards in order
-        shards = sorted(
-            leaf_id.addressable_shards, key=lambda s: s.index[0].start or 0
-        )
-        local = np.concatenate([np.asarray(s.data) for s in shards])[:n_local]
+            mk = jax.make_array_from_process_local_data
+            g_bins = mk(col_s, bins_T)
+            g_grad = mk(row_s, grad)
+            g_hess = mk(row_s, hess)
+            g_bag = mk(row_s, bag_mask)
+            # replicated small inputs go in as host numpy (identical on
+            # every process; jit replicates them without communication)
+            tree, leaf_id = sharded(
+                g_bins, g_grad, g_hess, g_bag,
+                np.asarray(fmask), np.asarray(nbpf), np.asarray(is_cat),
+                jax.tree.map(np.asarray, params),
+            )
+        with telemetry.span("dist.grow.fetch"):
+            # tree is replicated -> each process holds a full copy; the
+            # np.asarray here is the per-iteration sync point the desync
+            # sentinel piggybacks on
+            tree = jax.tree.map(
+                lambda a: np.asarray(a.addressable_data(0)), tree)
+            # leaf_id is row-sharded; stitch this process's shards in order
+            shards = sorted(
+                leaf_id.addressable_shards,
+                key=lambda s: s.index[0].start or 0
+            )
+            local = np.concatenate(
+                [np.asarray(s.data) for s in shards])[:n_local]
+        step_box[0] += 1
+        if sentinel.should_check(step_box[0]):
+            # fingerprint = (structural params crc, crc32 over every
+            # tree field's bytes): bitwise tree divergence (the thing
+            # the serial-equality dryrun pins offline) AND a rank
+            # training under different params are both caught HERE,
+            # named, within one iteration
+            if cfg_crc_box[0] is None:
+                cfg_crc_box[0] = dist.config_crc(
+                    jax.tree.map(lambda a: np.asarray(a).tolist(), params))
+            fp = dist.state_fingerprint(
+                step_box[0], cfg_crc_box[0],
+                *(np.asarray(f).tobytes() for f in tree))
+            sentinel.verify(step_box[0], fp)
         return tree, local
 
     return grow

@@ -51,7 +51,17 @@ def combine_gathered_split_infos(g: SplitResult) -> SplitResult:
     )
 
 
-def gather_and_combine(r: SplitResult, axis: str) -> SplitResult:
-    """One packed all_gather over ``axis`` + deterministic max."""
+def gather_and_combine(r: SplitResult, axis: str,
+                       site: str = None) -> SplitResult:
+    """One packed all_gather over ``axis`` + deterministic max.
+
+    ``site`` opts into the trace-time collective census (obs/dist.py):
+    callers on an audited path name their site so the per-op
+    collectives-per-split contract stays checkable."""
     g = jax.lax.all_gather(pack_split(r), axis)  # [D, 11]
+    if site:
+        from ..obs.dist import record_collective_site
+
+        record_collective_site(site, "all-gather",
+                               g.size * g.dtype.itemsize)
     return combine_gathered_split_infos(unpack_split(g))

@@ -24,6 +24,15 @@ spec                        injection point
                             mid-file before verification
                             (serving/hotswap.py) — the swap must be
                             refused and the old model keeps answering
+``delay_collective:R:MS``   rank R sleeps MS milliseconds before EVERY
+                            traced host collective (obs/dist.py) — the
+                            lab straggler: peers' barrier-wait skew must
+                            attribute to rank R (recurring, not
+                            self-consuming)
+``desync_step:R``           rank R perturbs its desync-sentinel
+                            fingerprint ONCE — the sentinel on every
+                            rank must detect and NAME rank R within one
+                            iteration
 ==========================  ====================================================
 
 The env var is read once at import (the repo-wide convention for
@@ -40,7 +49,8 @@ import signal
 from typing import Dict, Optional
 
 _VALID = ("kill_after_tree", "corrupt_checkpoint", "nan_grads",
-          "fail_collective_once", "fail_write_once", "corrupt_model")
+          "fail_collective_once", "fail_write_once", "corrupt_model",
+          "delay_collective", "desync_step")
 
 
 class InjectedFault(Exception):
@@ -171,6 +181,63 @@ def maybe_corrupt_checkpoint(path: str) -> bool:
         return False
     _overwrite_mid_file(path)
     _note("corrupt_checkpoint", path=path)
+    return True
+
+
+def _current_rank() -> int:
+    """Lazy rank resolution — ONE implementation, in obs/dist.py
+    (jax-if-already-imported -> launcher env -> 0; never imports jax,
+    honoring this module's stdlib-only contract).  Guarded: a fault
+    hook must degrade to rank 0, not raise."""
+    try:
+        from ..obs.dist import process_index
+
+        return process_index()
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def maybe_delay_collective(rank=None) -> None:
+    """obs/dist.traced_collective hook: when the active fault names THIS
+    rank, sleep the configured milliseconds before the barrier — every
+    peer then observes the delay as barrier-wait time attributable to
+    this rank.  Recurring (not ``_once``): a straggling chip straggles
+    every collective, and one delayed site would vanish into noise."""
+    p = fault_active("delay_collective")
+    if p is None:
+        return
+    want_rank, _, ms = p.partition(":")
+    try:
+        want, delay_ms = int(want_rank), float(ms or 0)
+    except ValueError:
+        raise ValueError(
+            f"delay_collective wants '<rank>:<ms>', got {p!r}") from None
+    me = _current_rank() if rank is None else int(rank)
+    if me != want or delay_ms <= 0:
+        return
+    import time
+
+    _note("delay_collective", rank=me, delay_ms=delay_ms)
+    time.sleep(delay_ms / 1000.0)
+
+
+def maybe_desync_step(rank=None) -> bool:
+    """Desync-sentinel hook (obs/dist.DesyncSentinel.local_row): when
+    the active fault names THIS rank, consume it and return True — the
+    sentinel then perturbs its fingerprint once, and every rank's next
+    verify must detect and name this rank."""
+    p = fault_active("desync_step")
+    if p is None:
+        return False
+    try:
+        want = int(p)
+    except ValueError:
+        raise ValueError(f"desync_step wants '<rank>', got {p!r}") from None
+    me = _current_rank() if rank is None else int(rank)
+    if me != want:
+        return False
+    _consume("desync_step")
+    _note("desync_step", rank=me)
     return True
 
 

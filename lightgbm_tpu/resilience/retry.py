@@ -49,6 +49,12 @@ TRANSIENT_MARKERS: Sequence[str] = (
 )
 
 
+def _counter_label(label: str) -> str:
+    """Human label -> counter-name segment ("config sync allgather
+    (pre-dispatch)" -> "config_sync_allgather_pre-dispatch")."""
+    return "_".join(label.replace("(", "").replace(")", "").split())
+
+
 def is_transient(exc: BaseException) -> bool:
     msg = f"{type(exc).__name__}: {exc}"
     return any(marker in msg for marker in TRANSIENT_MARKERS)
@@ -59,7 +65,9 @@ def retry_transient(fn: Callable[[], T], *, retries: int = 3,
                     label: str = "") -> T:
     """Call ``fn``; on a transient failure (see :func:`is_transient`)
     retry up to ``retries`` times with exponential backoff.  Counts
-    ``transient_retries`` in telemetry.  Non-transient exceptions and
+    ``transient_retries`` in telemetry, plus the label-scoped
+    ``transient_retries.<label>`` so a retry is attributable to the
+    specific collective/site it guarded.  Non-transient exceptions and
     the final transient failure propagate unchanged."""
     attempt = 0
     while True:
@@ -70,7 +78,13 @@ def retry_transient(fn: Callable[[], T], *, retries: int = 3,
                 raise
             attempt += 1
             delay = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
-            telemetry.count("transient_retries")
+            # attribute the retry to the specific collective/site: the
+            # bare global counter says "something retried somewhere",
+            # which on an 8-rank run is no attribution at all
+            adds = {"transient_retries": 1}
+            if label:
+                adds[f"transient_retries.{_counter_label(label)}"] = 1
+            telemetry.count_many(adds)
             Log.warning(
                 f"transient failure{f' in {label}' if label else ''} "
                 f"(attempt {attempt}/{retries}, retrying in {delay:.1f}s): "

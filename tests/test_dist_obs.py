@@ -1,0 +1,472 @@
+"""Distributed-run observability (obs/dist.py): merge/skew math,
+snapshot exchange, collective tracing, desync sentinels, manifest
+ranks[], rank_report, and the benchdiff multichip skew gate — all
+single-process (constructed snapshots / simulated worlds); the real
+8-process aggregation rides the env-gated tests in test_multihost.py
+and the dryrun's MULTICHIP tail."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import dist, flightrec, telemetry
+from lightgbm_tpu.obs.manifest import RunManifest, validate
+from lightgbm_tpu.resilience import faults
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snap(rank, world=3, counters=None, spans=None, reservoirs=None,
+          histograms=None):
+    """Constructed rank snapshot (the merge contract's input shape)."""
+    t = {"counters": dict(counters or {}),
+         "spans": dict(spans or {}),
+         "reservoirs": dict(reservoirs or {}),
+         "histograms": dict(histograms or {})}
+    return {"schema": dist.RANK_SCHEMA, "process_index": rank,
+            "process_count": world, "pid": 1000 + rank, "host": "h",
+            "device": {"backend": "cpu", "local_count": 1},
+            "created_unix": 0.0, "telemetry": t, "extra": {}}
+
+
+def _span(total, count=1):
+    return {"total_s": total, "count": count, "min_s": total / count,
+            "max_s": total / count}
+
+
+def _res(samples):
+    s = sorted(samples)
+    return {"count": len(samples), "window": len(samples),
+            "mean_s": sum(samples) / len(samples), "p50_s": s[len(s) // 2],
+            "p99_s": s[-1], "max_s": s[-1], "samples": list(samples)}
+
+
+# ------------------------------------------------------------------- merge
+def test_merge_counter_sums_exact():
+    snaps = [_snap(0, counters={"a": 3, "collective_ops": 7}),
+             _snap(1, counters={"a": 4, "b": 0.5}),
+             _snap(2, counters={"b": 0.25, "collective_ops": 7})]
+    m = dist.merge_snapshots(snaps)
+    assert m["schema"] == dist.MERGED_SCHEMA
+    assert m["world"] == 3 and m["ranks"] == [0, 1, 2]
+    # the acceptance contract: merged sums == per-rank sums EXACTLY
+    assert m["counters"]["a"] == 3 + 4
+    assert m["counters"]["b"] == 0.5 + 0.25
+    assert m["counters"]["collective_ops"] == 14
+
+
+def test_merge_span_totals_and_skew():
+    snaps = [_snap(0, spans={"dist.grow.dispatch": _span(1.0, 2)}),
+             _snap(1, spans={"dist.grow.dispatch": _span(3.0, 2)}),
+             _snap(2, spans={"dist.grow.dispatch": _span(2.0, 2)})]
+    m = dist.merge_snapshots(snaps)
+    st = m["spans"]["dist.grow.dispatch"]
+    assert st["total_s"] == pytest.approx(6.0) and st["count"] == 6
+    sk = m["span_skew"]["dist.grow.dispatch"]
+    assert sk["max_s"] == pytest.approx(3.0)
+    assert sk["min_s"] == pytest.approx(1.0)
+    assert sk["max_minus_min_s"] == pytest.approx(2.0)
+    assert sk["max_over_mean"] == pytest.approx(3.0 / 2.0)
+    assert sk["max_rank"] == 1 and sk["min_rank"] == 0
+    assert sk["per_rank"] == {"0": 1.0, "1": 3.0, "2": 2.0}
+
+
+def test_merge_reservoirs_recomputes_exact_window_quantiles():
+    # rank medians are 1.0 and 100.0; the MERGED median must come from
+    # the concatenated window (2.0), not an average of per-rank p50s
+    snaps = [_snap(0, world=2, reservoirs={"r": _res([1.0, 1.0, 2.0])}),
+             _snap(1, world=2, reservoirs={"r": _res([100.0, 2.0])})]
+    m = dist.merge_snapshots(snaps)
+    r = m["reservoirs"]["r"]
+    assert r["window"] == 5 and r["count"] == 5
+    assert r["p50_s"] == pytest.approx(2.0)
+    assert r["max_s"] == pytest.approx(100.0)
+    sk = m["reservoir_skew"]["r"]
+    assert sk["max_rank"] == 1 and sk["min_rank"] == 0
+
+
+def test_merge_histograms_sums_counts_and_records_conflicts():
+    h = {"bounds": [0.1, 1.0], "counts": [1, 2, 3], "count": 6, "sum": 4.0}
+    h2 = {"bounds": [0.1, 1.0], "counts": [1, 0, 0], "count": 1, "sum": 0.05}
+    hx = {"bounds": [0.5], "counts": [1, 0], "count": 1, "sum": 0.2}
+    m = dist.merge_snapshots([
+        _snap(0, histograms={"h": h, "x": h}),
+        _snap(1, histograms={"h": h2, "x": hx})])
+    assert m["histograms"]["h"]["counts"] == [2, 2, 3]
+    assert m["histograms"]["h"]["count"] == 7
+    assert m["histogram_merge_conflicts"] == ["x"]
+
+
+def test_merge_rejects_duplicate_ranks_and_empty():
+    with pytest.raises(ValueError, match="duplicate"):
+        dist.merge_snapshots([_snap(0), _snap(0)])
+    with pytest.raises(ValueError, match="no snapshots"):
+        dist.merge_snapshots([])
+
+
+def test_straggler_attribution_names_min_wait_rank():
+    # rank 2 arrived last: it waited ~0 while everyone else waited 0.1s
+    snaps = [_snap(r, reservoirs={
+        "collective.site_a.wait_s": _res([0.001 if r == 2 else 0.1]),
+        "collective.site_a.transfer_s": _res([0.01]),
+    }) for r in range(3)]
+    m = dist.merge_snapshots(snaps)
+    out = dist.attribute_stragglers(m)
+    assert out and out[0]["straggler_rank"] == 2
+    assert out[0]["site"] == "site_a"
+    assert out[0]["wait_skew_s"] == pytest.approx(0.099, abs=1e-6)
+    # below the floor -> no attribution (scheduling noise)
+    quiet = dist.merge_snapshots([
+        _snap(r, reservoirs={"collective.s.wait_s": _res([0.001])})
+        for r in range(3)])
+    assert dist.attribute_stragglers(quiet) == []
+
+
+def test_live_rank_snapshot_carries_samples_and_identity():
+    tel = telemetry.Telemetry()
+    tel.record_value("r", 0.5)
+    tel.record_value("r", 1.5)
+    s = dist.rank_snapshot(tel=tel, rank=4, world=8)
+    assert s["schema"] == dist.RANK_SCHEMA
+    assert s["process_index"] == 4 and s["process_count"] == 8
+    assert s["telemetry"]["reservoirs"]["r"]["samples"] == [0.5, 1.5]
+
+
+# ---------------------------------------------------------------- exchange
+def test_exchange_files_roundtrip_and_timeout(tmp_path):
+    d = str(tmp_path / "xdir")
+    tels = []
+    for r in range(3):
+        t = telemetry.Telemetry()
+        t.count("a", r + 1)
+        tels.append(t)
+        dist.write_rank_snapshot(
+            d, dist.rank_snapshot(tel=t, rank=r, world=3))
+    snaps = dist.gather_rank_snapshots(d, 3, timeout_s=5.0)
+    assert [s["process_index"] for s in snaps] == [0, 1, 2]
+    m = dist.merge_snapshots(snaps)
+    assert m["counters"]["a"] == 6
+    # a missing rank is NAMED in the timeout
+    with pytest.raises(TimeoutError, match=r"ranks \[3\]"):
+        dist.gather_rank_snapshots(d, 4, timeout_s=0.3, poll_s=0.05)
+
+
+def test_exchange_snapshots_single_process_short_circuits(tmp_path):
+    # world=1 resolves without touching the directory
+    m = dist.exchange_snapshots(str(tmp_path / "never_created"))
+    assert m is not None and m["world"] == 1
+    assert not (tmp_path / "never_created").exists()
+
+
+# ------------------------------------------------------ collective tracing
+def test_traced_collective_records_wait_transfer_and_per_op():
+    tel = telemetry.Telemetry()
+    out = dist.traced_collective(
+        lambda: 41 + 1, op="all-gather", label="probe",
+        payload_bytes=128, barrier_fn=lambda: None, tel=tel)
+    assert out == 42
+    assert tel.counter("collective_ops") == 1
+    assert tel.counter("collective_ops.op.all-gather") == 1
+    assert tel.counter("collective_bytes") == 128
+    assert tel.counter("collective_bytes.op.all-gather") == 128
+    assert len(tel.reservoir("collective.probe.wait_s")) == 1
+    assert len(tel.reservoir("collective.probe.transfer_s")) == 1
+
+
+def test_traced_collective_retry_attributed_to_label():
+    tel = telemetry.get_telemetry()
+    before = tel.counter("transient_retries")
+    faults.set_fault("fail_collective_once")
+    try:
+        out = dist.traced_collective(
+            lambda: "ok", op="all-gather", label="probe_site",
+            deadline_s=30.0)
+    finally:
+        faults.clear_faults()
+    assert out == "ok"
+    assert tel.counter("transient_retries") == before + 1
+    # the satellite fix: the retry carries the SITE's identity, not
+    # just a global count
+    assert tel.counter(
+        "transient_retries.probe_site_pre-dispatch") >= 1
+
+
+def test_delay_collective_fault_delays_only_named_rank():
+    import time as _time
+
+    faults.set_fault("delay_collective:1:80")
+    try:
+        t0 = _time.perf_counter()
+        faults.maybe_delay_collective(rank=0)
+        fast = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        faults.maybe_delay_collective(rank=1)
+        slow = _time.perf_counter() - t0
+    finally:
+        faults.clear_faults()
+    assert fast < 0.05 and slow >= 0.07
+    with pytest.raises(ValueError, match="delay_collective"):
+        faults.set_fault("delay_collective:bogus")
+        try:
+            faults.maybe_delay_collective(rank=0)
+        finally:
+            faults.clear_faults()
+
+
+# ---------------------------------------------------------- desync sentinel
+def test_sentinel_detects_and_names_diverging_rank(tmp_path):
+    flightrec.set_dump_dir(str(tmp_path))
+    flightrec.reset()
+    rows = np.asarray([[5, 111, 0], [5, 999, 1], [5, 111, 2]], np.int32)
+    s = dist.DesyncSentinel(world=3, rank=0, gather_fn=lambda row: rows)
+    with pytest.raises(dist.DesyncError) as ei:
+        s.verify(5, 111)
+    msg = str(ei.value)
+    assert "rank(s) [1]" in msg and "iteration 5" in msg
+    assert "fingerprint=111" in msg  # the consensus is named too
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec_") and f.endswith(".json")]
+    assert dumps, "desync detection left no flight-recorder dump"
+    rec = json.loads((tmp_path / dumps[0]).read_text())
+    assert rec["reason"] == "desync"
+    assert rec["events"][-1]["kind"] == "desync_detected"
+    assert rec["events"][-1]["divergent_ranks"] == [1]
+    flightrec.set_dump_dir("")
+
+
+def test_sentinel_agreement_and_cadence():
+    rows = np.asarray([[2, 7, 0], [2, 7, 1]], np.int32)
+    calls = []
+
+    def gather(row):
+        calls.append(1)
+        return rows
+
+    s = dist.DesyncSentinel(world=2, rank=0, gather_fn=gather,
+                            check_every=2)
+    s.verify(1, 7)   # off-cadence -> no exchange
+    s.verify(2, 7)   # on-cadence, agreeing -> no raise
+    assert len(calls) == 1
+    assert not dist.DesyncSentinel(world=1, rank=0).should_check(1)
+    assert not dist.DesyncSentinel(
+        world=2, rank=0, check_every=0).should_check(1)
+
+
+def test_desync_step_fault_perturbs_once():
+    s = dist.DesyncSentinel(world=2, rank=1)
+    faults.set_fault("desync_step:1")
+    try:
+        r1 = s.local_row(4, 50)
+        r2 = s.local_row(5, 50)
+    finally:
+        faults.clear_faults()
+    assert int(r1[1]) != 50, "fault did not perturb the fingerprint"
+    assert int(r2[1]) == 50, "desync_step must self-consume"
+
+
+def test_state_fingerprint_covers_payload_bytes():
+    a = dist.state_fingerprint(1, 0, b"tree-bytes")
+    b = dist.state_fingerprint(1, 0, b"tree-bytez")
+    c = dist.state_fingerprint(2, 0, b"tree-bytes")
+    assert len({a, b, c}) == 3
+    assert 0 <= a <= 0x7FFFFFFF
+
+
+# ------------------------------------------------- DP collective-site census
+def test_dp_sites_census_makes_per_split_contract_checkable():
+    """One fresh trace of the single-host DP grower: the trace-time
+    census must show exactly the documented per-split collective sites
+    (child-counts all-gather, histogram reduce-scatter, packed split
+    all-gather) plus the root-time sites — the 3-collectives/split
+    contract, checkable per-op."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.learners.serial import TreeLearnerParams
+    from lightgbm_tpu.parallel import data_mesh, make_data_parallel_grower
+
+    tel = telemetry.get_telemetry()
+    before = tel.snapshot()["counters"]
+    n, F, B, L = 256, 6, 16, 7
+    rng = np.random.RandomState(3)
+    bins = jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray((np.abs(rng.randn(n)) + 0.1).astype(np.float32))
+    params = TreeLearnerParams.from_config(Config(min_data_in_leaf=5))
+    grow = make_data_parallel_grower(data_mesh(), num_bins=B, max_leaves=L)
+    tree, _ = grow(bins, grad, hess, jnp.ones(n, jnp.float32),
+                   jnp.ones(F, bool), jnp.full(F, B, jnp.int32),
+                   jnp.zeros(F, bool), params)
+    assert int(tree.num_leaves) > 1
+    after = tel.snapshot()["counters"]
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    traces = delta("dp_grow_traces")
+    assert traces >= 1
+    # the per-SPLIT loop body: exactly these 3 sites, once per trace
+    assert delta(
+        "collective_site.dp.child_counts_allgather.all-gather") == traces
+    assert delta("collective_site.dp.split_allgather.all-gather") == traces
+    # hist reduce-scatter traces at the root AND in the loop body
+    assert delta(
+        "collective_site.dp.hist_reduce_scatter.reduce-scatter") == 2 * traces
+    assert delta(
+        "collective_site.dp.root_split_allgather.all-gather") == traces
+    # payload bytes recorded alongside (nonzero, op-attributed)
+    assert delta("collective_site_bytes.dp.split_allgather") > 0
+
+
+# -------------------------------------------------------- manifest ranks[]
+def test_manifest_ranks_roundtrip(tmp_path):
+    snaps = [_snap(r, counters={"backend_compiles": r + 1})
+             for r in range(2)]
+    ranks = dist.ranks_section(snaps)
+    m = RunManifest.collect(
+        "test.dist", result={"value": 1.0}, ranks=ranks,
+        extra={"distributed": dist.merged_manifest_extra(
+            dist.merge_snapshots(snaps))})
+    p = str(tmp_path / "m.manifest.json")
+    m.write(p)
+    loaded = RunManifest.load(p)
+    assert [r["process_index"] for r in loaded.ranks] == [0, 1]
+    assert loaded.ranks[0]["counters"]["backend_compiles"] == 1
+    assert loaded.extra["distributed"]["merged_counters"][
+        "backend_compiles"] == 3
+    # a pre-ranks[] v1 manifest (no key at all) still loads
+    d = m.to_dict()
+    d.pop("ranks")
+    validate(d)
+    assert RunManifest.from_dict(d).ranks == []
+
+
+# ------------------------------------------------- multichip + benchdiff
+def _multichip(world=8, value=1.0, skew_s=0.01, census=None):
+    merged = {"counters": dict(census or
+                               {"collective_ops.op.all-gather": 24}),
+              "spans": {}, "reservoirs": {}, "histograms": {}}
+    return {
+        "schema": dist.MULTICHIP_SCHEMA,
+        "world": world,
+        "devices": {"cpu": world},
+        "result": {"value": value, "unit": "s/tree"},
+        "ranks": [],
+        "merged": merged,
+        "skew": {"spans": {"dist.grow.dispatch": {
+            "mean_s": 0.5, "max_s": 0.5 + skew_s, "min_s": 0.5,
+            "max_minus_min_s": skew_s,
+            "max_over_mean": (0.5 + skew_s) / 0.5,
+            "max_rank": 3, "min_rank": 0, "reported": world,
+            "per_rank": {}}},
+            "reservoirs": {}},
+        "stragglers": [],
+        "extra": {},
+        "created_unix": 0.0,
+    }
+
+
+def _benchdiff(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "benchdiff.py"),
+         *argv],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_benchdiff_multichip_flags_doctored_skew_both_directions(tmp_path):
+    """The skew-regression gate (tier-1): a cross-rank skew growing
+    past the phase threshold is flagged even with a flat headline; the
+    reverse direction reports an improvement and exits clean."""
+    old = _write(tmp_path, "old.json", _multichip(skew_s=0.05))
+    new = _write(tmp_path, "new.json", _multichip(skew_s=0.25))
+    r = _benchdiff(old, new)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "cross-rank skew" in r.stdout and "rank 3" in r.stdout
+    r = _benchdiff(new, old)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "improvement" in r.stdout
+
+
+def test_benchdiff_multichip_headline_and_census(tmp_path):
+    old = _write(tmp_path, "o.json", _multichip(value=1.0))
+    new = _write(tmp_path, "n.json", _multichip(
+        value=1.3, census={"collective_ops.op.all-gather": 40}))
+    r = _benchdiff(old, new)
+    assert r.returncode == 1
+    assert "headline" in r.stdout
+    assert "collective census changed" in r.stdout
+
+
+def test_benchdiff_multichip_world_mismatch_and_cross_kind(tmp_path):
+    o8 = _write(tmp_path, "o8.json", _multichip(world=8))
+    o4 = _write(tmp_path, "o4.json", _multichip(world=4))
+    r = _benchdiff(o8, o4)
+    assert r.returncode == 2
+    assert "world sizes differ" in r.stderr
+    train = _write(tmp_path, "t.json",
+                   {"metric": "m", "value": 1.0, "unit": "s/tree"})
+    r = _benchdiff(o8, train)
+    assert r.returncode == 2
+    assert "not comparable" in r.stderr
+
+
+def test_benchdiff_multichip_appearing_skew_is_regression(tmp_path):
+    """A skew APPEARING from a clean 0 baseline is the worst straggler
+    regression — it must gate, not warn (review finding)."""
+    old = _write(tmp_path, "oa.json", _multichip(skew_s=0.0))
+    new = _write(tmp_path, "na.json", _multichip(skew_s=0.5))
+    r = _benchdiff(old, new)
+    assert r.returncode == 1, r.stdout
+    assert "appeared" in r.stdout and "rank 3" in r.stdout
+
+
+def test_benchdiff_multichip_small_skew_inside_floor_ignored(tmp_path):
+    # 5ms -> 15ms is +200% but under the absolute floor: noise, not
+    # a straggler
+    old = _write(tmp_path, "of.json", _multichip(skew_s=0.005))
+    new = _write(tmp_path, "nf.json", _multichip(skew_s=0.015))
+    r = _benchdiff(old, new)
+    assert r.returncode == 0, r.stdout
+
+
+# ------------------------------------------------------------- rank_report
+def test_rank_report_renders_artifact_and_exchange_dir(tmp_path):
+    snaps = []
+    for r in range(2):
+        t = telemetry.Telemetry()
+        t.count("backend_compiles", 2)
+        t.record_value(f"collective.site.wait_s", 0.2 if r == 0 else 0.001)
+        with t.span("dist.grow.dispatch"):
+            pass
+        snaps.append(dist.rank_snapshot(tel=t, rank=r, world=2))
+    merged = dist.merge_snapshots(snaps)
+    art = dist.multichip_artifact(merged, snaps,
+                                  result={"value": 0.5, "unit": "s/tree"})
+    p = _write(tmp_path, "mc.json", art)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "rank_report.py"), p],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    # rank 1 straggles (least wait) -> exit 1 + named in the report
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "straggler site: rank 1" in r.stdout
+    assert "rank" in r.stdout and "device" in r.stdout
+    # a raw exchange dir renders too
+    d = tmp_path / "xd"
+    for s in snaps:
+        dist.write_rank_snapshot(str(d), s)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "rank_report.py"),
+         str(d)],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "merged 2 rank snapshots" in r.stdout

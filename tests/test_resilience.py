@@ -502,7 +502,7 @@ def test_chaos_dryrun_smoke():
     assert summary["failures"] == 0
     assert set(summary["results"]) == {
         "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
-        "serve_swap", "serve_fail_write"}
+        "serve_swap", "serve_fail_write", "desync", "straggler"}
     # ISSUE 14: the preemption and refused-swap scenarios now also
     # assert a flight-recorder post-mortem (atomic + checksum sidecar,
     # tail = the triggering event) — pinned via the scenario details so
@@ -511,6 +511,13 @@ def test_chaos_dryrun_smoke():
         summary["results"]["kill_resume"]["detail"]
     assert "flight-recorder dump (tail=swap_refused)" in \
         summary["results"]["serve_swap"]["detail"]
+    # ISSUE 15: the distributed scenarios pin detection-and-naming +
+    # rank-tagged dumps and straggler attribution (obs/dist.py)
+    assert "names rank 1" in summary["results"]["desync"]["detail"]
+    assert "rank-tagged filenames collision-free" in \
+        summary["results"]["desync"]["detail"]
+    assert "attributed to rank 1" in \
+        summary["results"]["straggler"]["detail"]
 
 
 @pytest.mark.slow

@@ -14,6 +14,15 @@ Accepted input formats (auto-detected per file):
 * raw bench.py rows       (``{"metric": ..., "value": ...}``)
 * run manifests           (``*.manifest.json`` — obs.manifest v1; the
   headline comes from ``result``, phases from ``phases``)
+* multichip artifacts     (``lightgbm-tpu/multichip-bench/v1`` from the
+  8-process dryrun / a real multi-chip run, obs/dist.py): diffs the
+  headline under the usual threshold plus a SKEW-REGRESSION gate — the
+  per-span / per-collective cross-rank skews (max−min seconds) must not
+  grow past the phase threshold above an absolute floor, so a run that
+  stays flat in aggregate but develops a straggling rank is flagged;
+  a changed collective census (per-op counts) is warned about.  World
+  sizes must match (exit 2 otherwise — 4-rank skew and 8-rank skew are
+  not comparable).
 * serving bench artifacts (``.bench/serving_*.json`` —
   ``lightgbm-tpu/serving-bench/v1`` from tools/bench_serving.py):
   online mode diffs p50 (headline threshold) / p99 (phase threshold) /
@@ -49,6 +58,10 @@ AUC_ABS = 0.002  # an AUC drop is a correctness smell, not a perf one
 
 MANIFEST_SCHEMA = "lightgbm-tpu/run-manifest/v1"
 SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
+MULTICHIP_SCHEMA = "lightgbm-tpu/multichip-bench/v1"
+# cross-rank skew gate: a skew below this absolute floor is scheduling
+# noise on any backend — relative growth only matters above it
+SKEW_ABS_FLOOR_S = 0.02
 # serving error-rate discipline: a regression needs BOTH an absolute
 # rise above this floor (noise guard; also covers a 0 baseline) and —
 # when the baseline had errors — a relative rise past the headline
@@ -88,12 +101,42 @@ def _normalize_serving(raw: dict, rec: dict) -> dict:
     return rec
 
 
+def _normalize_multichip(raw: dict, rec: dict) -> dict:
+    """Multichip artifacts: headline from ``result.value``; the skew
+    tables (span + reservoir, already ``{name: {max_minus_min_s, ...}}``)
+    ride flattened for the skew-regression gate; per-op collective
+    counts ride for the census warning."""
+    rec["kind"] = "multichip"
+    rec["world"] = raw.get("world")
+    row = dict(raw.get("result") or {})
+    rec["value"] = row.get("value")
+    rec["unit"] = row.get("unit", "s")
+    skew = raw.get("skew") or {}
+    flat = {}
+    for group in ("spans", "reservoirs"):
+        for name, sk in (skew.get(group) or {}).items():
+            flat[name] = sk
+    rec["skew"] = flat
+    counters = (raw.get("merged") or {}).get("counters") or {}
+    rec["collective_census"] = {
+        k: counters[k] for k in sorted(counters)
+        if k.startswith(("collective_ops.op.", "collective_site."))}
+    rec["stragglers"] = raw.get("stragglers") or []
+    if rec.get("value") in (None, 0, 0.0):
+        raise ValueError(
+            f"{rec['path']}: multichip artifact has no usable headline "
+            "(result.value)")
+    return rec
+
+
 def normalize(path: str) -> dict:
     """One record shape for every accepted input format:
     ``{label, value, unit, vs_baseline, auc..., phases, compile...}``."""
     raw = _load(path)
     rec: dict = {"label": os.path.basename(path), "path": path,
                  "phases": {}, "sha": None, "kind": "training"}
+    if raw.get("schema") == MULTICHIP_SCHEMA:
+        return _normalize_multichip(raw, rec)
     if raw.get("schema") == SERVING_SCHEMA or "serving" in raw:
         return _normalize_serving(raw, rec)
     if raw.get("schema") == MANIFEST_SCHEMA:
@@ -230,11 +273,96 @@ def diff_serving(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
             "warnings": warnings, "improvements": improvements}
 
 
+def diff_multichip(old: dict, new: dict,
+                   headline_pct: float = HEADLINE_PCT,
+                   phase_pct: float = PHASE_PCT) -> dict:
+    """Multichip comparison: headline under the usual threshold, plus
+    the skew-regression gate — a cross-rank skew (max−min seconds of a
+    span/collective series) growing past ``phase_pct`` above the
+    absolute floor is a regression even when the headline stays flat
+    (one straggling rank hides inside an aggregate mean)."""
+    regressions, warnings, improvements = [], [], []
+    if old.get("world") != new.get("world"):
+        raise ValueError(
+            f"multichip world sizes differ (old: {old.get('world')}, "
+            f"new: {new.get('world')}) — skew across different worlds "
+            "is not comparable")
+    unit = new.get("unit", "s")
+    ov, nv = float(old["value"]), float(new["value"])
+    head = _pct(ov, nv)
+    headline = {"old": ov, "new": nv, "unit": unit,
+                "delta_pct": round(head, 1), "world": new.get("world")}
+    if head >= headline_pct:
+        regressions.append(
+            f"headline {unit} {ov:.4g} -> {nv:.4g} (+{head:.1f}%, "
+            f"threshold +{headline_pct:.0f}%)")
+    elif head <= -headline_pct:
+        improvements.append(
+            f"headline {unit} {ov:.4g} -> {nv:.4g} ({head:.1f}%)")
+
+    osk, nsk = old.get("skew") or {}, new.get("skew") or {}
+    for name in sorted(set(osk) ^ set(nsk)):
+        side = "old" if name in osk else "new"
+        warnings.append(
+            f"skew series '{name}' present only in the {side} artifact "
+            "— instrumentation coverage changed between the two runs")
+    for name in sorted(set(osk) & set(nsk)):
+        o = float((osk[name] or {}).get("max_minus_min_s") or 0.0)
+        n = float((nsk[name] or {}).get("max_minus_min_s") or 0.0)
+        if n <= SKEW_ABS_FLOOR_S and o <= SKEW_ABS_FLOOR_S:
+            continue  # both inside scheduling noise
+        if o <= 0:
+            # a skew APPEARING from a clean baseline is the worst
+            # straggler regression, not a footnote — a 0s -> 5s skew
+            # must never pass a gate a 0.03s -> 0.04s one fails
+            regressions.append(
+                f"cross-rank skew '{name}' appeared: 0 -> {n:.4f}s "
+                f"max-min (implicated rank "
+                f"{(nsk[name] or {}).get('max_rank')})")
+            continue
+        d = _pct(o, n)
+        who = (nsk[name] or {}).get("min_rank") \
+            if name.endswith(".wait_s") else (nsk[name] or {}).get("max_rank")
+        if d >= phase_pct and n > SKEW_ABS_FLOOR_S:
+            regressions.append(
+                f"cross-rank skew '{name}' {o:.4f}s -> {n:.4f}s max-min "
+                f"(+{d:.1f}%, threshold +{phase_pct:.0f}%; implicated "
+                f"rank {who})")
+        elif d <= -phase_pct and o > SKEW_ABS_FLOOR_S:
+            improvements.append(
+                f"cross-rank skew '{name}' {o:.4f}s -> {n:.4f}s "
+                f"({d:.1f}%)")
+
+    oc = old.get("collective_census") or {}
+    nc = new.get("collective_census") or {}
+    if oc and nc and oc != nc:
+        changed = sorted(k for k in set(oc) | set(nc)
+                         if oc.get(k) != nc.get(k))
+        warnings.append(
+            "collective census changed (the per-op contract moved): "
+            + ", ".join(f"{k} {oc.get(k, 0)} -> {nc.get(k, 0)}"
+                        for k in changed[:6])
+            + (" ..." if len(changed) > 6 else ""))
+    for s in new.get("stragglers") or []:
+        warnings.append(
+            f"NEW run names a straggler: rank {s.get('straggler_rank')} "
+            f"at {s.get('site')} (wait skew {s.get('wait_skew_s')}s)")
+    return {"headline": headline, "regressions": regressions,
+            "warnings": warnings, "improvements": improvements}
+
+
 def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
          phase_pct: float = PHASE_PCT) -> dict:
     """Compare two normalized records; returns
     ``{regressions: [...], warnings: [...], improvements: [...],
     headline: {...}}``."""
+    if "multichip" in (old.get("kind"), new.get("kind")):
+        if old.get("kind") != new.get("kind"):
+            raise ValueError(
+                f"{old['label']} is a {old.get('kind')} artifact, "
+                f"{new['label']} is a {new.get('kind')} artifact — "
+                "multichip and other results are not comparable")
+        return diff_multichip(old, new, headline_pct, phase_pct)
     if "serving" in (old.get("kind"), new.get("kind")):
         if old.get("kind") != new.get("kind"):
             raise ValueError(
@@ -384,7 +512,10 @@ def main(argv: Optional[list] = None) -> int:
     print(f"benchdiff: {old['label']} -> {new['label']}")
     delta = ("n/a" if h["delta_pct"] is None
              else f"{h['delta_pct']:+.1f}%")
-    if new.get("kind") == "serving":
+    if new.get("kind") == "multichip":
+        print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
+              f"{h['unit']} ({delta}) at world={h.get('world')}")
+    elif new.get("kind") == "serving":
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta})")
     else:
@@ -396,7 +527,7 @@ def main(argv: Optional[list] = None) -> int:
         print(f"  warning: {w}")
     for i in report["improvements"]:
         print(f"  improvement: {i}")
-    if new.get("kind") != "serving":
+    if new.get("kind") not in ("serving", "multichip"):
         print("  driver-config row (paste into the commit message):")
         print("  " + driver_row(new))
 

@@ -25,6 +25,15 @@ status; the fault matrix lives in docs/resilience.md):
 * ``serve_fail_write`` — fail the batch-tier result writer's atomic
   commit (``fail_write_once``) mid predict_file; the existing result
   must stay intact and no partial file may appear.
+* ``desync`` — a simulated 2-rank world where rank 1's sentinel
+  fingerprint is perturbed (``desync_step:1``); every rank's verify
+  must raise :class:`DesyncError` NAMING rank 1 and the iteration, and
+  leave rank-tagged flight-recorder dumps (tail = ``desync_detected``)
+  with no cross-rank filename collision.
+* ``straggler`` — a simulated 2-rank collective where rank 1 sleeps
+  before the barrier (``delay_collective:1:<ms>``); rank 0's
+  barrier-wait must absorb the delay, and the merged-snapshot skew
+  must attribute the straggle to rank 1.
 
 Modes:
 
@@ -61,7 +70,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
-             "collective", "serve_swap", "serve_fail_write")
+             "collective", "serve_swap", "serve_fail_write",
+             "desync", "straggler")
 
 
 def log(msg: str) -> None:
@@ -314,6 +324,109 @@ def scenario_serve_fail_write_inproc(tmp: str) -> str:
             "intact, no partial files")
 
 
+def scenario_desync_inproc(tmp: str) -> str:
+    """Distributed fault scenario 1 (obs/dist.py): a rank whose
+    training state silently diverged must be DETECTED AND NAMED within
+    one iteration by the sentinel, with rank-tagged flight-recorder
+    dumps that cannot collide across ranks."""
+    import numpy as np
+
+    from lightgbm_tpu.obs import dist, flightrec
+    from lightgbm_tpu.resilience import faults
+
+    flightrec.set_dump_dir(tmp)
+    flightrec.reset()
+    step, fp = 3, 12345
+    # two simulated ranks in one process: each builds its own sentinel
+    # row (the desync_step fault perturbs rank 1's fingerprint ONCE),
+    # and a fake gather hands every verifier the same 2-rank world
+    s0 = dist.DesyncSentinel(world=2, rank=0)
+    s1 = dist.DesyncSentinel(world=2, rank=1)
+    faults.set_fault("desync_step:1")
+    try:
+        row1 = s1.local_row(step, fp)
+        assert int(row1[1]) != fp, "desync_step fault did not perturb"
+        rows = np.stack([s0.local_row(step, fp), row1])
+        flightrec.set_rank(0)
+        try:
+            s0._gather = lambda row: rows
+            s0.verify(step, fp)
+            raise AssertionError("sentinel did not detect the desync")
+        except dist.DesyncError as e:
+            msg = str(e)
+            assert "rank(s) [1]" in msg and "iteration 3" in msg, (
+                f"desync error does not name rank 1 / iteration 3: {msg}")
+    finally:
+        faults.clear_faults()
+        flightrec.set_rank(None)
+    # the detection left a post-mortem whose tail IS the detection ...
+    _assert_flightrec_dump(tmp, "desync_detected", "desync")
+    # ... under a rank-tagged name that cannot collide with a peer's
+    p0 = flightrec.dump_path(tmp)
+    flightrec.set_rank(1)
+    try:
+        p1 = flightrec.dump_path(tmp)
+    finally:
+        flightrec.set_rank(None)
+    assert os.path.basename(p0 or "").startswith("flightrec_r0_"), p0
+    assert os.path.basename(p1 or "").startswith("flightrec_r1_"), p1
+    assert p0 != p1, "cross-rank flight-recorder filename collision"
+    return ("simulated 2-rank desync -> DesyncError names rank 1 at "
+            "iteration 3, flight-recorder dump (tail=desync_detected), "
+            "rank-tagged filenames collision-free")
+
+
+def scenario_straggler_inproc(tmp: str) -> str:
+    """Distributed fault scenario 2: an injected per-rank collective
+    delay must surface as BARRIER-WAIT skew attributed to the delayed
+    rank in the merged snapshot (the straggler is the rank that waited
+    least — everyone else's wait is time spent waiting for it)."""
+    import threading
+
+    from lightgbm_tpu.obs import dist, telemetry
+    from lightgbm_tpu.resilience import faults
+
+    delay_ms = 120.0
+    world = 2
+    tels = [telemetry.Telemetry() for _ in range(world)]
+    barrier = threading.Barrier(world)
+    faults.set_fault(f"delay_collective:1:{delay_ms:.0f}")
+    errs = []
+
+    def rank_body(r: int) -> None:
+        try:
+            for _ in range(3):
+                dist.traced_collective(
+                    lambda: None, op="all-gather", label="chaos_probe",
+                    payload_bytes=24, barrier_fn=barrier.wait,
+                    rank=r, tel=tels[r])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=rank_body, args=(r,))
+                   for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        faults.clear_faults()
+    assert not errs, f"simulated ranks failed: {errs}"
+    merged = dist.merge_snapshots([
+        dist.rank_snapshot(tel=tels[r], rank=r, world=world)
+        for r in range(world)])
+    sk = merged["reservoir_skew"]["collective.chaos_probe.wait_s"]
+    assert sk["max_minus_min_s"] >= 0.5 * delay_ms / 1000.0, (
+        f"rank 0's barrier wait did not absorb the injected delay: {sk}")
+    stragglers = dist.attribute_stragglers(merged)
+    assert stragglers and stragglers[0]["straggler_rank"] == 1, (
+        f"straggler not attributed to the delayed rank: {stragglers}")
+    return (f"injected {delay_ms:.0f}ms delay on rank 1 -> barrier-wait "
+            f"skew {sk['max_minus_min_s'] * 1e3:.0f}ms attributed to "
+            "rank 1 in the merged snapshot")
+
+
 def scenario_collective_inproc(tmp: str) -> str:
     from lightgbm_tpu.resilience import faults
     from lightgbm_tpu.resilience.retry import guarded_collective
@@ -454,6 +567,8 @@ def main() -> int:
         run("collective", scenario_collective_inproc, tmp)
         run("serve_swap", scenario_serve_swap_inproc, tmp, 4)
         run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
+        run("desync", scenario_desync_inproc, tmp)
+        run("straggler", scenario_straggler_inproc, tmp)
     else:
         run("kill_resume", scenario_kill_resume_subproc, tmp, args.trees,
             args.seed)
@@ -466,6 +581,12 @@ def main() -> int:
         # surface (checksum verify, atomic commit) is process-local
         run("serve_swap", scenario_serve_swap_inproc, tmp, 4)
         run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
+        # the distributed scenarios simulate their worlds in-process in
+        # both modes (the REAL multi-process versions live behind the
+        # env-gated tests/test_multihost.py aggregation tests — this
+        # container cannot run multiprocess collectives)
+        run("desync", scenario_desync_inproc, tmp)
+        run("straggler", scenario_straggler_inproc, tmp)
 
     summary = {"mode": "dryrun" if args.dryrun else "subprocess",
                "seed": args.seed, "failures": failures,

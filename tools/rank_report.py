@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""rank_report: render the per-rank breakdown of a distributed run.
+
+The cross-rank question a flat BENCH number cannot answer: *which rank*
+was slow, *which collective* dominated, *who* straggled.  This tool
+reads any of the distributed-observability artifacts (obs/dist.py) and
+prints the per-rank table + skew/straggler attribution:
+
+* a multichip artifact (``lightgbm-tpu/multichip-bench/v1`` — the
+  8-process dryrun tail's source, or a real multi-chip run);
+* a run manifest carrying a ``ranks[]`` section (rank 0's merged
+  ``<output_model>.manifest.json``);
+* a rank-snapshot exchange directory (``rank_<i>.json`` files — the raw
+  per-rank evidence when no merge happened, e.g. rank 0 died).
+
+Usage:
+    python tools/rank_report.py PATH [--json OUT]
+
+Exit codes: 0 = rendered, 1 = stragglers detected (report still
+printed — greppable as a gate), 2 = unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from lightgbm_tpu.obs import dist  # noqa: E402
+
+MANIFEST_SCHEMA = "lightgbm-tpu/run-manifest/v1"
+
+
+def _load_ranks_and_merged(path: str):
+    """(ranks_section, merged, provenance) from any accepted input."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "rank_*.json")))
+        if not files:
+            raise ValueError(f"{path}: no rank_<i>.json snapshots inside")
+        snaps = []
+        for f in files:
+            with open(f) as fh:
+                snaps.append(json.load(fh))
+        merged = dist.merge_snapshots(snaps)
+        return dist.ranks_section(snaps), merged, \
+            f"merged {len(snaps)} rank snapshots from {path}"
+    with open(path) as fh:
+        raw = json.load(fh)
+    if raw.get("schema") == dist.MULTICHIP_SCHEMA:
+        merged = dict(raw.get("merged") or {})
+        skew = raw.get("skew") or {}
+        merged.setdefault("span_skew", skew.get("spans") or {})
+        merged.setdefault("reservoir_skew", skew.get("reservoirs") or {})
+        merged.setdefault("world", raw.get("world"))
+        return raw.get("ranks") or [], merged, \
+            f"multichip artifact {path} (world={raw.get('world')})"
+    if raw.get("schema") == MANIFEST_SCHEMA:
+        ranks = raw.get("ranks") or []
+        if not ranks:
+            raise ValueError(
+                f"{path}: manifest has no ranks[] section — single-rank "
+                "run, or written before the distributed-obs layer")
+        d = (raw.get("extra") or {}).get("distributed") or {}
+        merged = {
+            "world": d.get("world") or len(ranks),
+            "counters": d.get("merged_counters") or {},
+            "spans": {}, "reservoirs": {},
+            "span_skew": d.get("span_skew") or {},
+            "reservoir_skew": d.get("reservoir_skew") or {},
+        }
+        return ranks, merged, \
+            f"run manifest {path} (entry={raw.get('entry')})"
+    raise ValueError(
+        f"{path}: not a multichip artifact, a ranks[] manifest, or a "
+        "rank-snapshot directory")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="multichip artifact / merged manifest / "
+                                 "rank-snapshot exchange dir")
+    ap.add_argument("--json", help="also write {ranks, merged, "
+                                   "stragglers} here (atomic)")
+    args = ap.parse_args(argv)
+
+    try:
+        ranks, merged, provenance = _load_ranks_and_merged(args.path)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"rank_report: {e}", file=sys.stderr)
+        return 2
+
+    print(f"rank_report: {provenance}")
+    sha = dist.artifact_sha(args.path) if os.path.isfile(args.path) else None
+    if sha:
+        print(f"  artifact sha256[:16]: {sha}")
+    for line in dist.render_rank_table(merged, ranks):
+        print("  " + line)
+    counters = merged.get("counters") or {}
+    coll = {k: v for k, v in sorted(counters.items())
+            if k.startswith(("collective_ops", "collective_site."))}
+    if coll:
+        print("  merged collective census:")
+        for k, v in coll.items():
+            print(f"    {k} = {int(v) if float(v).is_integer() else v}")
+    stragglers = dist.attribute_stragglers(merged)
+
+    if args.json:
+        from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+        atomic_write_json(args.json, {"ranks": ranks, "merged": merged,
+                                      "stragglers": stragglers})
+    return 1 if stragglers else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
