@@ -541,7 +541,19 @@ def _emit_result(out: dict, info: dict, key: str) -> None:
     happens)."""
     try:
         from lightgbm_tpu.obs import RunManifest, telemetry
+        from lightgbm_tpu.obs import memory as obs_memory
 
+        # device-memory evidence ships INSIDE the row like the warm-up
+        # evidence (hbm_peak_bytes is benchdiff's +15% memory gate) and
+        # in full as the manifest's memory{} section
+        try:
+            mem_section = obs_memory.manifest_memory_section()
+            peak = int(mem_section["hbm"]["hbm_peak_bytes"]
+                       or obs_memory.peak_bytes())
+            if peak:
+                out.setdefault("hbm_peak_bytes", peak)
+        except Exception:
+            mem_section = {}
         mdir = os.environ.get("BENCH_MANIFEST_DIR", CACHE_DIR)
         path = os.path.join(mdir, f"bench_{key}.manifest.json")
         manifest = RunManifest.collect(
@@ -555,6 +567,7 @@ def _emit_result(out: dict, info: dict, key: str) -> None:
             warmup={k: info[k] for k in (
                 "warmup_iters", "warm_trees_discarded", "compile_stable",
                 "compiles_warmup", "compiles_timed") if k in info},
+            memory=mem_section,
         )
         manifest.write(path)
         repo = os.path.dirname(os.path.abspath(__file__))

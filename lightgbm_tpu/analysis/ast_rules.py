@@ -115,6 +115,15 @@ AST_RULES: Dict[str, str] = {
         "resilience.atomic_write / atomic_write_json / atomic_writer "
         "(tmp + fsync + rename); append-mode logs are exempt"
     ),
+    "device-buffer-retention": (
+        "module-global or class-attribute assignment of a jax/jnp "
+        "device value from runtime code in a hot/serving/obs module: "
+        "the buffer is pinned in device memory for the process "
+        "lifetime, invisible to owner-attributed census accounting "
+        "(obs/memory.py) and to hot-swap reclamation.  Keep device "
+        "buffers on instances registered via obs.memory.register_owner "
+        "(docs/memory.md), or suppress with the justification inline"
+    ),
     "unbounded-event-buffer": (
         "append/extend to a module-level list from function code in a "
         "hot/serving/obs module with no maxlen/ring discipline: a "
@@ -254,7 +263,8 @@ class _RuleWalker(ast.NodeVisitor):
                  findings: List[Finding],
                  jit_roots: Optional[Set[str]] = None,
                  module_lists: Optional[Set[str]] = None,
-                 event_scope: bool = False) -> None:
+                 event_scope: bool = False,
+                 module_classes: Optional[Set[str]] = None) -> None:
         self.path = path
         self.traced = traced
         self.hot = hot
@@ -266,6 +276,11 @@ class _RuleWalker(ast.NodeVisitor):
         # hot/serving/obs scope the rule applies to
         self.module_lists = module_lists or set()
         self.event_scope = event_scope
+        # device-buffer-retention context: module-level class names
+        # (a ClassName.attr store is process-lifetime retention) and
+        # names this function declared ``global``
+        self.module_classes = module_classes or set()
+        self._global_names: Set[str] = set()
         # wallclock-without-sync event streams (line-ordered within the
         # walked function; nested defs are walked separately)
         self._time_marks: Dict[str, List[int]] = {}
@@ -341,7 +356,67 @@ class _RuleWalker(ast.NodeVisitor):
                 if isinstance(tgt, ast.Name):
                     self._time_marks.setdefault(tgt.id, []).append(
                         node.lineno)
+        self._check_buffer_retention(node, node.targets, node.value)
         self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_buffer_retention(node, [node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._global_names.update(node.names)
+
+    # ------------------------------------------ device-buffer-retention
+    def _is_device_value(self, value: ast.AST) -> bool:
+        """A jax/jnp-rooted call (or a call into one of this module's
+        jit roots) — the expressions whose results live in device
+        memory.  Host numpy and plain Python values are not flagged."""
+        if not isinstance(value, ast.Call):
+            return False
+        if _is_jax_jit(value.func) or _is_partial_of_jit(value):
+            # a cached jitted CALLABLE retains compiled code, not a
+            # device buffer — the idiomatic module-level dispatch cache
+            return False
+        name = _dotted(value.func)
+        if name is None:
+            return False
+        root, leaf = name.split(".")[0], name.split(".")[-1]
+        return root in _DEVICE_ROOTS or leaf in self.jit_roots
+
+    def _check_buffer_retention(self, node: ast.AST,
+                                targets: List[ast.AST],
+                                value: ast.AST) -> None:
+        """device-buffer-retention: ``global NAME; NAME = jnp.f(...)``
+        or ``ClassName.attr = jnp.f(...)`` from runtime code in an
+        event-scope module parks a device buffer where no census owner
+        can see it and no teardown frees it.  Instance attributes
+        (``self.x = ...``) stay legal — they die with their owner."""
+        if not self.event_scope or not self._is_device_value(value):
+            return
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id in self._global_names:
+                self.flag(
+                    "device-buffer-retention", node,
+                    f"global '{tgt.id}' is bound to a device value from "
+                    "runtime code: the buffer outlives every request and "
+                    "is invisible to owner-attributed census accounting "
+                    "— keep it on an instance registered via "
+                    "obs.memory.register_owner (docs/memory.md)",
+                )
+            elif isinstance(tgt, ast.Attribute):
+                root = tgt.value
+                if (isinstance(root, ast.Name)
+                        and root.id in self.module_classes):
+                    self.flag(
+                        "device-buffer-retention", node,
+                        f"class attribute '{root.id}.{tgt.attr}' is bound "
+                        "to a device value from runtime code: a "
+                        "process-lifetime pin shared across instances, "
+                        "invisible to census owner attribution — keep "
+                        "device buffers on instances registered via "
+                        "obs.memory.register_owner (docs/memory.md)",
+                    )
 
     def visit_BinOp(self, node: ast.BinOp) -> None:
         # stop timestamp: `time.perf_counter() - t0` (t0 a recorded mark)
@@ -641,6 +716,8 @@ def lint_source(source: str, path: str = "<string>",
     hot = _is_hot(path) if hot is None else hot
     module_lists = _module_level_lists(tree)
     event_scope = _is_event_scope(path)
+    module_classes = {n.name for n in tree.body
+                      if isinstance(n, ast.ClassDef)}
 
     findings: List[Finding] = []
 
@@ -648,7 +725,8 @@ def lint_source(source: str, path: str = "<string>",
         walker = _RuleWalker(path, is_traced, hot, findings,
                              jit_roots=index.jit_roots,
                              module_lists=module_lists,
-                             event_scope=event_scope)
+                             event_scope=event_scope,
+                             module_classes=module_classes)
         for stmt in fn.body:  # type: ignore[attr-defined]
             walker.visit(stmt)
         walker.finish()

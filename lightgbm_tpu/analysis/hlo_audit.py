@@ -23,6 +23,12 @@ the aliased placement kernel, and the matmul predictor, then checks:
   over the same shapes must add zero backend compiles
   (``steady_loop_recompiles``; the tier-1 test drives the real grow
   loop through it).
+* **hlo-memory-budget** — ``compiled.memory_analysis()`` bytes
+  (temp/argument/output) against ``mem_*`` ceilings in the same
+  budgets file: the static half of the memory-observability layer
+  (obs/memory.py is the runtime half) — an XLA temp allocation that
+  balloons at the pinned shape fails tier-1 before any chip time is
+  spent.
 
 Budgets are CPU-backend numbers at pinned small shapes; see
 docs/jaxlint.md for the update workflow (never raise a budget to make
@@ -58,6 +64,11 @@ ARTIFACT_RULES: Dict[str, str] = {
         "an iteration of an already-warm loop triggered a backend "
         "compile — lazy recompiles pollute any timed loop"
     ),
+    "hlo-memory-budget": (
+        "compiled.memory_analysis() bytes (temp/argument/output) exceed "
+        "the committed memory budget in analysis/budgets.json — a "
+        "kernel change ballooned XLA's allocation at the pinned shape"
+    ),
 }
 
 _HLO_OP = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[^=]*?\s([\w\-]+)\(")
@@ -90,9 +101,30 @@ def hlo_op_counts(hlo_text: str) -> Dict[str, int]:
     return dict(counts)
 
 
+def _memory_analysis(compiled) -> dict:
+    """``compiled.memory_analysis()`` normalized to plain ints (the
+    static half of obs/memory.py's accounting).  {} when the backend
+    does not expose it — the budget gate then treats the entry as
+    unmeasurable rather than zero."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for key in ("temp_size_in_bytes", "argument_size_in_bytes",
+                "output_size_in_bytes", "generated_code_size_in_bytes",
+                "alias_size_in_bytes"):
+        val = getattr(ma, key, None)
+        if val is not None:
+            out[key.replace("_size_in_bytes", "_bytes")] = int(val)
+    return out
+
+
 def _compile_entry(lowered):
     """Compile a lowered computation, capturing donation warnings.
-    Returns (op_counts, has_alias, warning_strings)."""
+    Returns (op_counts, has_alias, warning_strings, memory_bytes)."""
     with warnings.catch_warnings(record=True) as wlog:
         warnings.simplefilter("always")
         compiled = lowered.compile()
@@ -102,7 +134,8 @@ def _compile_entry(lowered):
         str(w.message) for w in wlog
         if _DONATION_WARNING.search(str(w.message))
     ]
-    return hlo_op_counts(txt), has_alias, donation_warnings
+    return (hlo_op_counts(txt), has_alias, donation_warnings,
+            _memory_analysis(compiled))
 
 
 def _jaxpr_use_count(closed_jaxpr, invar_index: int) -> int:
@@ -152,9 +185,9 @@ def _measure_grow_tree_serial() -> dict:
 
     args = _grow_inputs()
     lowered = grow_tree.lower(*args, num_bins=_B, max_leaves=_L)
-    ops, has_alias, dwarn = _compile_entry(lowered)
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
     return {"ops": ops, "donation": None, "donation_warnings": dwarn,
-            "has_alias": has_alias}
+            "has_alias": has_alias, "memory": mem}
 
 
 def _split_step_inputs():
@@ -201,9 +234,10 @@ def _measure_split_step_window() -> dict:
         hists, rec, s["begin"], s["pcnt"], s["do_split"], s["f"],
         s["thr"], s["is_cat"], s["parent_slot"], s["new_slot"],
         scal_f, meta, F=_F, cap=cap, k=k, interpret=True)
-    ops, has_alias, dwarn = _compile_entry(lowered)
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
     return {"ops": ops, "donation": has_alias and not dwarn,
-            "donation_warnings": dwarn, "has_alias": has_alias}
+            "donation_warnings": dwarn, "has_alias": has_alias,
+            "memory": mem}
 
 
 def _measure_split_step_record_chain() -> dict:
@@ -247,7 +281,7 @@ def _measure_place_runs() -> dict:
     kw = dict(cap=cap, leaf_row=rec_mod.num_words(_F, k) + 4)
 
     lowered = rec_mod.place_runs.lower(rec, *args, interpret=True, **kw)
-    ops, has_alias, dwarn = _compile_entry(lowered)
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
 
     def run_hw(rec_):
         return rec_mod.place_runs(rec_, *args, interpret=False, **kw)
@@ -256,7 +290,8 @@ def _measure_place_runs() -> dict:
     uses = _jaxpr_use_count(jaxpr, 0)
     return {"ops": ops, "donation": has_alias and not dwarn,
             "donation_warnings": dwarn, "has_alias": has_alias,
-            "record_uses": uses, "record_single_use": uses == 1}
+            "record_uses": uses, "record_single_use": uses == 1,
+            "memory": mem}
 
 
 def _measure_partition_window() -> dict:
@@ -275,9 +310,10 @@ def _measure_partition_window() -> dict:
         rec, go, s["begin"], s["pcnt"], s["do_split"], cap,
         jnp.int32(0), jnp.int32(1),
         leaf_row=rec_mod.num_words(_F, k) + 4, interpret=True)
-    ops, has_alias, dwarn = _compile_entry(lowered)
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
     return {"ops": ops, "donation": None, "donation_warnings": dwarn,
-            "has_alias": has_alias, "routing": rec_mod.ROUTING}
+            "has_alias": has_alias, "routing": rec_mod.ROUTING,
+            "memory": mem}
 
 
 def _measure_predict_matmul() -> dict:
@@ -298,10 +334,10 @@ def _measure_predict_matmul() -> dict:
     X = jnp.asarray(np.random.RandomState(0)
                     .randn(64, _F).astype(np.float32))
     lowered = ensemble_sum_matmul.lower(tables, stacked, X)
-    ops, has_alias, dwarn = _compile_entry(lowered)
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
     ops.setdefault("gather", 0)
     return {"ops": ops, "donation": None, "donation_warnings": dwarn,
-            "has_alias": has_alias}
+            "has_alias": has_alias, "memory": mem}
 
 
 def _measure_post_grow_step() -> dict:
@@ -320,9 +356,10 @@ def _measure_post_grow_step() -> dict:
     lowered = _post_grow_step.lower(
         tree, scores, jnp.int32(0), leaf_id, jnp.float32(0.1),
         bounds_mat, real_feat)
-    ops, has_alias, dwarn = _compile_entry(lowered)
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
     return {"ops": ops, "donation": has_alias and not dwarn,
-            "donation_warnings": dwarn, "has_alias": has_alias}
+            "donation_warnings": dwarn, "has_alias": has_alias,
+            "memory": mem}
 
 
 _ENTRY_MEASURERS = {
@@ -390,6 +427,23 @@ def check_budgets(measured: dict, budgets: dict,
                         f"{m.get('record_uses')} equations (expected 1)"))
             elif key.startswith("_"):
                 continue  # comment/metadata keys
+            elif key.startswith("mem_"):
+                # static memory budget: compiled.memory_analysis()
+                # bytes (mem_temp_bytes -> memory["temp_bytes"], ...)
+                mem = m.get("memory", {})
+                if not mem:
+                    findings.append(Finding(
+                        "hlo-memory-budget", path, 0,
+                        f"{name}: '{key}' budgeted but the backend "
+                        "exposed no memory_analysis()"))
+                    continue
+                got = mem.get(key[len("mem_"):], 0)
+                if got > limit:
+                    findings.append(Finding(
+                        "hlo-memory-budget", path, 0,
+                        f"{name}: memory_analysis "
+                        f"'{key[len('mem_'):]}' {got} bytes exceeds "
+                        f"budget {limit}"))
             else:
                 got = m.get("ops", {}).get(key, 0)
                 if got > limit:

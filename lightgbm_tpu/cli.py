@@ -310,6 +310,12 @@ def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
                 ranks = []
                 extra["distributed"] = {
                     "gather_error": f"{type(e).__name__}: {str(e)[:300]}"}
+        try:
+            from .obs import memory as obs_memory
+
+            mem_section = obs_memory.manifest_memory_section()
+        except Exception:
+            mem_section = {}
         manifest = RunManifest.collect(
             "cli.train", config=cfg,
             result={"num_trees": booster.num_trees,
@@ -319,6 +325,7 @@ def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
             per_tree_reservoir="tree_dispatch_s",
             ranks=ranks,
             extra=extra,
+            memory=mem_section,
         )
         path = manifest.write(manifest_path(cfg.output_model))
         Log.info(f"Wrote run manifest to {path}")

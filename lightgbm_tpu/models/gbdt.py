@@ -27,6 +27,7 @@ import numpy as np
 from ..compat import enable_x64
 from ..config import Config
 from ..io.dataset import BinnedDataset
+from ..obs import memory as obs_memory
 from ..obs import telemetry
 from ..resilience import faults
 from ..resilience.atomic import atomic_write
@@ -186,9 +187,28 @@ class GBDT:
         self._scores = jnp.asarray(scores)
         self._bag_mask = jnp.ones(n, jnp.float32)
         self._bag_cnt = n
+        # memory-census owner tags (obs/memory.py).  Getters resolve
+        # the CURRENT attributes at census time, so the per-iteration
+        # reassignment of _scores stays covered; the registry keeps
+        # only a weakref to this booster, so dropping the booster
+        # frees everything (the leak-detector contract).
+        for tok in (getattr(self, "_mem_tokens", None) or ()):
+            obs_memory.unregister_owner(tok)
+        self._mem_tokens = (
+            obs_memory.register_owner(
+                "dataset", self,
+                lambda b: (b._bins_T, b._nbpf, b._is_cat,
+                           b._bounds_mat, b._real_feat_dev)),
+            obs_memory.register_owner(
+                "scores", self,
+                lambda b: (b._scores, b._bag_mask,
+                           getattr(b, "_valid_scores", []),
+                           getattr(b, "_valid_bins", []))),
+        )
         self.train_metrics = create_metrics(
             self.config, train_set.metadata, n
         )
+        obs_memory.phase_boundary("binning")
         # rollback support: keep per-iteration train score deltas off-device?
         # cheaper: recompute on rollback from stored trees (rare path).
 
@@ -512,11 +532,45 @@ class GBDT:
         loop; device phase attribution from obs.device_time traces."""
         t0 = time.perf_counter()
         try:
+            # chaos hook (LGBM_TPU_FAULT=oom_dispatch): fake
+            # RESOURCE_EXHAUSTED through the same classifier a real one hits
+            faults.maybe_oom_dispatch("train")
             return self._train_one_iter_impl(grad, hess)
+        except Exception as e:
+            # OOM post-mortem (obs/memory.py): flight-recorder dump with
+            # the last census + the analytic model's prediction for this
+            # shape; non-OOM errors pass through untouched
+            obs_memory.classify_dispatch_error(
+                e, "train.dispatch", shape=self._memmodel_params(),
+                predict_params=self._memmodel_params())
+            raise
         finally:
             telemetry.count("train_iters")
             telemetry.record_value(
                 "tree_dispatch_s", time.perf_counter() - t0)
+            obs_memory.phase_boundary("train")
+
+    def _memmodel_params(self) -> Optional[dict]:
+        """This booster's shape in obs/memmodel.predict vocabulary
+        (attached to OOM post-mortems so the dump carries the expected
+        footprint beside the measured census)."""
+        if getattr(self, "_bins_T", None) is None:
+            return None
+        try:
+            return {
+                "rows": int(self.num_data),
+                "features": int(self._bins_T.shape[0]),
+                "bins": int(self._num_bins),
+                "leaves": int(self.max_leaves),
+                "num_class": int(self.num_class),
+                "world": int(jax.process_count()),
+                "routing": ("order" if self.config.tree_learner == "serial"
+                            else "prefix"),
+                "hist_prec": ("float64" if self._use_f64_hist
+                              else "float32"),
+            }
+        except Exception:
+            return None
 
     def _train_one_iter_impl(
         self,

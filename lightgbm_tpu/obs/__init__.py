@@ -23,13 +23,27 @@ The runtime half of ROADMAP item 1's "make perf un-regressable"
 * :mod:`~lightgbm_tpu.obs.dist` — the cross-rank layer: rank-scoped
   snapshots, merge + skew attribution, host-side snapshot exchange,
   per-collective tracing (barrier-wait vs transfer), desync sentinels.
+* :mod:`~lightgbm_tpu.obs.memory` — device-memory accounting: the
+  shared ``memory_stats()`` reader, owner-tagged live-buffer census,
+  host-boundary watermarks, ``lgbm_memory_*`` gauges, OOM post-mortems.
+* :mod:`~lightgbm_tpu.obs.memmodel` — analytic HBM footprint model
+  (expected live-set per phase from first principles); the planning
+  artifact behind ``tools/hbm_budget.py``.
 
 See docs/observability.md for the schemas and the reading guide.
 """
 
 from __future__ import annotations
 
-from . import dist, export, flightrec, telemetry, tracing  # noqa: F401
+from . import (  # noqa: F401
+    dist,
+    export,
+    flightrec,
+    memmodel,
+    memory,
+    telemetry,
+    tracing,
+)
 from .manifest import (  # noqa: F401
     RunManifest,
     config_fingerprint,

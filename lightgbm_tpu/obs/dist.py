@@ -134,7 +134,7 @@ def rank_snapshot(tel: Optional[telemetry.Telemetry] = None,
     merge can recompute exact window quantiles instead of averaging
     percentiles (which is wrong for any skewed distribution)."""
     tel = tel or telemetry.get_telemetry()
-    return {
+    snap = {
         "schema": RANK_SCHEMA,
         "process_index": process_index() if rank is None else int(rank),
         "process_count": process_count() if world is None else int(world),
@@ -145,6 +145,21 @@ def rank_snapshot(tel: Optional[telemetry.Telemetry] = None,
         "telemetry": tel.snapshot(include_samples=True),
         "extra": dict(extra or {}),
     }
+    # Every rank snapshot carries its own device-memory high-water mark so
+    # the merged artifact can show memory skew beside time skew.  The shared
+    # reader degrades to the census high-water on backends without allocator
+    # stats (CPU); an extra-provided value wins (test hooks).
+    if "hbm_peak_bytes" not in snap["extra"]:
+        try:
+            from . import memory as obs_memory
+            st = obs_memory.hbm_stats()
+            snap["hbm_peak_bytes"] = int(
+                st.get("hbm_peak_bytes") or obs_memory.peak_bytes())
+        except Exception:  # noqa: BLE001 - memory evidence is best-effort
+            snap["hbm_peak_bytes"] = 0
+    else:
+        snap["hbm_peak_bytes"] = int(snap["extra"]["hbm_peak_bytes"])
+    return snap
 
 
 def _skew(per_rank: Dict[int, float]) -> dict:
@@ -395,7 +410,7 @@ def ranks_section(snaps: Sequence[dict]) -> List[dict]:
         res = {k: {kk: v[kk] for kk in ("count", "mean_s", "p50_s", "p99_s")
                    if kk in v}
                for k, v in (t.get("reservoirs") or {}).items()}
-        out.append({
+        row = {
             "process_index": int(s.get("process_index", 0)),
             "pid": s.get("pid"),
             "host": s.get("host"),
@@ -403,7 +418,12 @@ def ranks_section(snaps: Sequence[dict]) -> List[dict]:
             "counters": dict(t.get("counters") or {}),
             "spans": dict(t.get("spans") or {}),
             "reservoirs": res,
-        })
+        }
+        hbm = s.get("hbm_peak_bytes",
+                    (s.get("extra") or {}).get("hbm_peak_bytes"))
+        if hbm is not None:
+            row["hbm_peak_bytes"] = int(hbm)
+        out.append(row)
     return out
 
 
@@ -652,10 +672,12 @@ def render_rank_table(merged: dict, ranks: Sequence[dict],
     wait_names = sorted(
         n for n in (merged.get("reservoirs") or {})
         if n.startswith("collective.") and n.endswith(".wait_s"))
+    have_hbm = any((r.get("hbm_peak_bytes") or 0) > 0 for r in ranks)
     head = (["rank", "device"] + list(counters)
             + [f"{n} s" for n in span_names]
             + [f"{n[len('collective.'):-len('.wait_s')]} wait-mean s"
-               for n in wait_names])
+               for n in wait_names]
+            + (["hbm_peak MiB"] if have_hbm else []))
     rows = [head]
     for r in ranks:
         dev = r.get("device") or {}
@@ -669,6 +691,8 @@ def render_rank_table(merged: dict, ranks: Sequence[dict],
         res = r.get("reservoirs") or {}
         cells += [_fmt_cell((res.get(n) or {}).get("mean_s", 0.0))
                   for n in wait_names]
+        if have_hbm:
+            cells.append(f"{(r.get('hbm_peak_bytes') or 0) / 2**20:.2f}")
         rows.append(cells)
     widths = [max(len(row[i]) for row in rows) for i in range(len(head))]
     lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
@@ -684,6 +708,18 @@ def render_rank_table(merged: dict, ranks: Sequence[dict],
             f"straggler {s['site']}: rank {s['straggler_rank']} "
             f"(wait skew {s['wait_skew_s']:.4f}s, max/mean "
             f"{s['max_over_mean']:.2f})")
+    hbm = {int(r.get("process_index", 0)): int(r.get("hbm_peak_bytes") or 0)
+           for r in ranks if (r.get("hbm_peak_bytes") or 0) > 0}
+    if len(hbm) >= 2:
+        ordered = sorted(hbm)
+        vals = [hbm[r] for r in ordered]
+        vmax, vmin = max(vals), min(vals)
+        pct = 100.0 * (vmax - vmin) / vmin if vmin > 0 else 0.0
+        lines.append(
+            f"memory skew hbm_peak_bytes: max-min "
+            f"{(vmax - vmin) / 2**20:.2f} MiB (+{pct:.1f}%, "
+            f"max r{ordered[vals.index(vmax)]} / "
+            f"min r{ordered[vals.index(vmin)]})")
     return lines
 
 

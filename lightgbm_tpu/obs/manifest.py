@@ -149,6 +149,10 @@ class RunManifest:
     # single-process runs; optional in v1 (validate does not require
     # it), so every existing manifest still loads.
     ranks: list = dataclasses.field(default_factory=list)
+    # device-memory section beside phases{} (obs/memory.py:
+    # manifest_memory_section()): hbm gauges, boundary watermarks,
+    # owner-tagged census summary.  Optional in v1 like ``ranks``.
+    memory: dict = dataclasses.field(default_factory=dict)
     schema: str = SCHEMA
 
     @classmethod
@@ -158,7 +162,8 @@ class RunManifest:
                 warmup: Optional[dict] = None,
                 per_tree_reservoir: str = "tree_s",
                 extra: Optional[dict] = None,
-                ranks: Optional[list] = None) -> "RunManifest":
+                ranks: Optional[list] = None,
+                memory: Optional[dict] = None) -> "RunManifest":
         """Gather everything the process knows right now.  ``entry`` is
         the entry point name ("bench.py", "cli.train", "northstar")."""
         tel = get_telemetry()
@@ -178,6 +183,7 @@ class RunManifest:
             result=dict(result or {}),
             extra=dict(extra or {}),
             ranks=list(ranks or []),
+            memory=dict(memory or {}),
         )
 
     def to_dict(self) -> dict:

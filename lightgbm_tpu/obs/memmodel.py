@@ -1,0 +1,224 @@
+"""Analytic HBM footprint model: expected live-set per phase from
+first principles.
+
+``predict(rows, features, ...)`` sums the allocations the training and
+serving paths actually make (equations below mirror the real buffer
+shapes in io/dataset.py, models/gbdt.py, learners/serial.py,
+ops/record.py, serving/engine.py; docs/memory.md carries the same
+table with derivations):
+
+* binned dataset      ``F * n * bin_bytes``      (uint8, uint16 >256 bins)
+* scores              ``K * n * 4``              (float32 raw scores)
+* grad/hess           ``2 * K * n * gb``         (gb=8 under float64 hists)
+* bagging mask        ``n * 4``
+* histograms          ``L * F * B * 3 * hb``     (resident leaf-tier)
+* routing scratch     order: ``n * 4``;
+                      record: ``rec_height(F) * round_up(n, TILE) * 4``
+                      (prefix); onehot ~2x for the compose buffer
+* serving buckets     ``sum_b (b * F * 4 + b * K * 8)``
+
+``n`` is rows/world (data-parallel shards the row dimension).  The
+per-phase live sets compose these: the histogram/split-search phases
+hold hists + grads, partition holds routing scratch instead, etc.
+``peak_bytes`` is the max over phases — the number the 100M-row wall
+(ROADMAP items 3/4) is planned against via tools/hbm_budget.py.
+
+Validated in tier-1 against the measured live-buffer census
+(obs/memory.py) at pinned shapes within TOLERANCE_PCT.  Pure python —
+no jax, importable anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Tuple
+
+SCHEMA = "lightgbm-tpu/memmodel/v1"
+
+# documented census-vs-model tolerance (relative %, plus a small
+# absolute floor for the tiny per-feature side arrays the model folds
+# into its components): tier-1 pins model-vs-census within this band.
+TOLERANCE_PCT = 20.0
+TOLERANCE_ABS_BYTES = 8192
+
+# record-mode routing layout constants (must mirror ops/record.py)
+_REC_TILE = 512
+_REC_STAT_ROWS = 5        # grad, hess, mask, row id, leaf id
+_REC_HEIGHT_ALIGN = 8
+
+PHASES = ("binning", "histogram", "split-search", "partition",
+          "leaf-update", "predict")
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((int(x) + m - 1) // m) * m
+
+
+def _rec_height(features: int, bin_bytes: int) -> int:
+    bins_per_word = 4 if bin_bytes == 1 else 2
+    num_words = -(-int(features) // bins_per_word)
+    return _round_up(num_words + _REC_STAT_ROWS, _REC_HEIGHT_ALIGN)
+
+
+def predict(rows: int, features: int, bins: int = 255, leaves: int = 31,
+            num_class: int = 1, world: int = 1, routing: str = "prefix",
+            hist_prec: str = "float32",
+            bucket_rows: Iterable[int] = ()) -> dict:
+    """Expected per-chip live set, per phase, in bytes.
+
+    ``routing`` is one of ``order`` (serial scatter learner),
+    ``prefix`` / ``onehot`` (record-mode partition kernels).
+    ``bucket_rows`` lists the serving shape-bucket capacities when the
+    chip also serves.  All sizes are per data-parallel shard
+    (``rows / world``)."""
+    rows = int(rows)
+    features = int(features)
+    bins = int(bins)
+    leaves = int(leaves)
+    num_class = max(1, int(num_class))
+    world = max(1, int(world))
+    n = -(-rows // world)
+
+    bin_bytes = 1 if bins <= 256 else 2
+    hist_bytes = 8 if str(hist_prec) in (
+        "float64", "f64", "fp64", "double") else 4
+    grad_bytes = hist_bytes  # float64 hists upcast the grad/hess pair
+
+    dataset = features * n * bin_bytes
+    scores = num_class * n * 4
+    bag_mask = n * 4
+    grad_hess = 2 * num_class * n * grad_bytes
+    hists = leaves * features * bins * 3 * hist_bytes
+
+    if routing == "order":
+        routing_scratch = n * 4
+    else:
+        rec = _rec_height(features, bin_bytes) * _round_up(
+            max(n, 1), _REC_TILE) * 4
+        routing_scratch = rec if routing == "prefix" else 2 * rec
+
+    buckets = [int(b) for b in bucket_rows]
+    serving = sum(b * features * 4 + b * num_class * 8 for b in buckets)
+
+    raw_input = features * n * 4  # float32 source during quantization
+    components: Dict[str, int] = {
+        "raw_input": raw_input,
+        "dataset": dataset,
+        "scores": scores,
+        "bag_mask": bag_mask,
+        "grad_hess": grad_hess,
+        "histograms": hists,
+        "routing": routing_scratch,
+        "serving": serving,
+    }
+    # what stays resident between dispatches (what a between-iteration
+    # census sees): the binned matrix + score/bag buffers (+ serving
+    # pads when bucket_rows given); raw_input lives only through binning
+    resident = dataset + scores + bag_mask + serving
+
+    phases: Dict[str, int] = {
+        "binning": raw_input + dataset + scores + bag_mask,
+        "histogram": resident + grad_hess + hists,
+        "split-search": resident + grad_hess + hists,
+        "partition": resident + grad_hess + routing_scratch,
+        "leaf-update": resident + grad_hess,
+        "predict": resident,
+    }
+    peak_phase = max(phases, key=lambda p: phases[p])
+    return {
+        "schema": SCHEMA,
+        "params": {
+            "rows": rows, "features": features, "bins": bins,
+            "leaves": leaves, "num_class": num_class, "world": world,
+            "routing": routing, "hist_prec": str(hist_prec),
+            "bucket_rows": buckets, "rows_per_shard": n,
+        },
+        "components": components,
+        "resident_bytes": int(resident),
+        "phases": {k: int(v) for k, v in phases.items()},
+        "peak_bytes": int(phases[peak_phase]),
+        "peak_phase": peak_phase,
+    }
+
+
+def limiting_component(pred: dict) -> Tuple[str, int]:
+    """The largest single allocation in the peak phase — the first
+    thing out-of-core work (ROADMAP item 3) must shard or stream."""
+    comps = dict(pred["components"])
+    phase = pred["peak_phase"]
+    # components not live in the peak phase can't be the limiter
+    live = {
+        "binning": ("raw_input", "dataset", "scores", "bag_mask"),
+        "histogram": ("dataset", "scores", "bag_mask", "grad_hess",
+                      "histograms", "serving"),
+        "split-search": ("dataset", "scores", "bag_mask", "grad_hess",
+                         "histograms", "serving"),
+        "partition": ("dataset", "scores", "bag_mask", "grad_hess",
+                      "routing", "serving"),
+        "leaf-update": ("dataset", "scores", "bag_mask", "grad_hess",
+                        "serving"),
+        "predict": ("dataset", "scores", "bag_mask", "serving"),
+    }[phase]
+    name = max(live, key=lambda c: comps.get(c, 0))
+    return name, int(comps.get(name, 0))
+
+
+def max_rows(capacity_bytes: int, **params: Any) -> int:
+    """Largest row count whose predicted peak fits ``capacity_bytes``
+    (binary search; 0 when even 1 row does not fit).  ``params`` are
+    the non-``rows`` arguments of :func:`predict`."""
+    capacity = int(capacity_bytes)
+    if predict(rows=1, **params)["peak_bytes"] > capacity:
+        return 0
+    lo, hi = 1, 2
+    while predict(rows=hi, **params)["peak_bytes"] <= capacity:
+        lo, hi = hi, hi * 2
+        if hi > 1 << 44:
+            return lo
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        if predict(rows=mid, **params)["peak_bytes"] <= capacity:
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def rows_curve(capacity_bytes: int, row_points: Iterable[int],
+               **params: Any) -> dict:
+    """The rows-vs-HBM planning artifact tools/hbm_budget.py prints:
+    predicted peak at each row count, the capacity ceiling, and the
+    allocation that hits the wall first."""
+    points = []
+    for r in row_points:
+        pred = predict(rows=int(r), **params)
+        points.append({
+            "rows": int(r),
+            "peak_bytes": pred["peak_bytes"],
+            "peak_phase": pred["peak_phase"],
+            "fits": pred["peak_bytes"] <= int(capacity_bytes),
+        })
+    cap_rows = max_rows(capacity_bytes, **params)
+    at_wall = predict(rows=max(cap_rows, 1), **params)
+    limiter, limiter_bytes = limiting_component(at_wall)
+    return {
+        "schema": SCHEMA,
+        "capacity_bytes": int(capacity_bytes),
+        "params": at_wall["params"],
+        "points": points,
+        "max_rows": cap_rows,
+        "wall": {
+            "peak_phase": at_wall["peak_phase"],
+            "limiting_component": limiter,
+            "limiting_bytes": limiter_bytes,
+            "components": at_wall["components"],
+        },
+    }
+
+
+def within_tolerance(model_bytes: int, measured_bytes: int,
+                     pct: float = TOLERANCE_PCT,
+                     abs_floor: int = TOLERANCE_ABS_BYTES) -> bool:
+    """The documented agreement predicate tier-1 pins: |model -
+    measured| <= max(pct% of measured, abs_floor)."""
+    slack = max(abs(measured_bytes) * pct / 100.0, float(abs_floor))
+    return abs(int(model_bytes) - int(measured_bytes)) <= slack

@@ -33,6 +33,10 @@ spec                        injection point
                             fingerprint ONCE — the sentinel on every
                             rank must detect and NAME rank R within one
                             iteration
+``oom_dispatch``            the next train/serve dispatch raises a fake
+                            ``RESOURCE_EXHAUSTED`` (self-consuming) —
+                            exercises the OOM classifier + flight
+                            recorder post-mortem (obs/memory.py)
 ==========================  ====================================================
 
 The env var is read once at import (the repo-wide convention for
@@ -50,7 +54,7 @@ from typing import Dict, Optional
 
 _VALID = ("kill_after_tree", "corrupt_checkpoint", "nan_grads",
           "fail_collective_once", "fail_write_once", "corrupt_model",
-          "delay_collective", "desync_step")
+          "delay_collective", "desync_step", "oom_dispatch")
 
 
 class InjectedFault(Exception):
@@ -65,6 +69,13 @@ class InjectedWriteError(InjectedFault, OSError):
 
 class InjectedCollectiveError(InjectedFault, RuntimeError):
     pass
+
+
+class InjectedResourceExhausted(InjectedFault, RuntimeError):
+    """Fake device OOM.  The message carries the literal
+    ``RESOURCE_EXHAUSTED`` marker, matching what XlaRuntimeError puts
+    in-text, so the classifier (obs/memory.is_oom_error) keys on the
+    same evidence it would see from a real allocator failure."""
 
 
 def _parse(spec: str) -> Dict[str, Optional[str]]:
@@ -239,6 +250,19 @@ def maybe_desync_step(rank=None) -> bool:
     _consume("desync_step")
     _note("desync_step", rank=me)
     return True
+
+
+def maybe_oom_dispatch(where: str) -> None:
+    """Train/serve dispatch hook (models/gbdt.py train_one_iter,
+    serving/engine.py _dispatch_rows): one fake RESOURCE_EXHAUSTED at
+    the next dispatch.  Self-consuming — a real OOM kills one dispatch;
+    the interesting behavior is the post-mortem, not a crash loop."""
+    if fault_active("oom_dispatch") is not None:
+        _consume("oom_dispatch")
+        _note("oom_dispatch", where=where)
+        raise InjectedResourceExhausted(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory at {where} "
+            "dispatch (allocator reported no free device memory)")
 
 
 def maybe_corrupt_model(path: str) -> bool:

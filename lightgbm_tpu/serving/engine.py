@@ -49,6 +49,8 @@ import jax.numpy as jnp
 
 from ..log import Log
 from ..obs import flightrec, telemetry
+from ..obs import memory as obs_memory
+from ..resilience import faults
 
 DEFAULT_MAX_BATCH_ROWS = 1024
 DEFAULT_MIN_BUCKET = 8
@@ -204,6 +206,13 @@ class ServingEngine:
         # load balancer can tell "just flipped" from "steady" (set at
         # construction too — engine start IS the first adoption)
         self._swap_monotonic = time.perf_counter()
+        # census owner tag: resolves the ACTIVE model's device tensors
+        # at census time, so after a hot-swap the census attributes the
+        # new model's buffers and shows the old model's freed (weakref
+        # registry — never extends any buffer's lifetime)
+        self._mem_token = obs_memory.register_owner(
+            "serving", self,
+            lambda e: (e._active.stacked, e._active.tables))
         if warm:
             self.prewarm()
 
@@ -277,12 +286,29 @@ class ServingEngine:
         if clock is not None:
             t1 = time.perf_counter()
             clock.add("pad_s", t1 - t0)
-        out = _bucket_dispatch()(pm.tables, pm.stacked, Xj)
-        res = np.asarray(out, np.float64)[:, :n]
+        try:
+            # chaos hook (oom_dispatch) + OOM post-mortem: same
+            # classifier path a real RESOURCE_EXHAUSTED takes
+            faults.maybe_oom_dispatch("serve")
+            out = _bucket_dispatch()(pm.tables, pm.stacked, Xj)
+            res = np.asarray(out, np.float64)[:, :n]
+        except Exception as e:
+            obs_memory.classify_dispatch_error(
+                e, "serve.dispatch",
+                shape={"rows": int(n), "bucket": int(b),
+                       "features": int(pm.num_features),
+                       "num_class": int(pm.num_class),
+                       "model_id": pm.model_id[:16]},
+                predict_params={"rows": int(b),
+                                "features": int(pm.num_features),
+                                "num_class": int(pm.num_class),
+                                "bucket_rows": list(self.buckets)})
+            raise
         if clock is not None:
             clock.add("device_s", time.perf_counter() - t1)
         telemetry.count("serving.dispatches")
         telemetry.record_value("serving.batch_occupancy", n / b)
+        obs_memory.phase_boundary("serve")
         return res
 
     def predict_with_meta(self, X, raw_score: bool = False,
@@ -370,6 +396,7 @@ class ServingEngine:
             self._active = new_pm
             self._swap_monotonic = time.perf_counter()
         telemetry.count("serving.swaps")
+        obs_memory.phase_boundary("swap")
         flightrec.record("swap", old_model_id=old.model_id[:16],
                          new_model_id=new_pm.model_id[:16],
                          num_trees=new_pm.num_trees)

@@ -47,6 +47,7 @@ import numpy as np
 from ..log import Log
 from ..obs import RunManifest, telemetry, tracing
 from ..obs import export as metrics_export
+from ..obs import memory as obs_memory
 from ..resilience.atomic import ArtifactCorrupt
 from .engine import ServingEngine
 from .queue import MicroBatchQueue
@@ -168,6 +169,12 @@ def api_metrics(engine: ServingEngine,
         "lgbm_serving_bucket_count": (
             len(engine.buckets), "size of the padded-shape bucket ladder"),
     }
+    # device-memory gauges (obs/memory.py): allocator stats + the
+    # owner-tagged live-buffer census, fresh per scrape
+    try:
+        gauges.update(obs_memory.memory_gauges())
+    except Exception:  # never let a census failure take down /metrics
+        pass
     body = metrics_export.render_prometheus(
         telemetry.get_telemetry().snapshot(), gauges=gauges)
     return 200, body

@@ -105,3 +105,43 @@ def test_record_chain_single_use(measured):
     for name in ("split_step_record_chain", "place_runs"):
         assert measured[name].get("record_single_use") is True, (
             name, measured[name])
+
+
+# -------------------------------------------- static memory budgets (PR 16)
+
+def test_memory_budgets_present_and_measured(measured, budgets):
+    """Every compile-based entry point exposes memory_analysis() bytes
+    AND carries committed mem_* ceilings — the static half of the HBM
+    accounting (docs/memory.md); test_budgets_hold enforces them."""
+    for name in ("grow_tree_serial", "split_step_window", "place_runs",
+                 "partition_window", "predict_matmul", "post_grow_step"):
+        ent = budgets["entries"][name]
+        assert any(k.startswith("mem_") for k in ent), (
+            f"{name}: no mem_* budget committed")
+        mem = measured[name].get("memory") or {}
+        assert "temp_bytes" in mem and "output_bytes" in mem, (name, mem)
+        # the measurement is real, not a zeroed fallback
+        assert mem["output_bytes"] > 0, (name, mem)
+
+
+def test_gate_fails_when_memory_budget_exceeded(measured):
+    """The memory gate has teeth: one byte under the measured XLA temp
+    allocation, check_budgets must report hlo-memory-budget — the
+    scratch-ballooning class fails tier-1 before any chip time."""
+    got = (measured["split_step_window"].get("memory") or {}).get(
+        "temp_bytes", 0)
+    assert got > 0, measured["split_step_window"].get("memory")
+    tight = {"entries": {"split_step_window": {"mem_temp_bytes": got - 1}}}
+    findings = check_budgets(measured, tight)
+    assert [f.rule for f in findings] == ["hlo-memory-budget"], findings
+
+
+def test_memory_budget_without_memory_analysis_is_flagged(measured):
+    """A backend that stops exposing memory_analysis() must fail the
+    budgeted entries loudly, not silently stop gating."""
+    broken = dict(measured)
+    broken["split_step_window"] = dict(measured["split_step_window"],
+                                       memory={})
+    findings = check_budgets(
+        broken, {"entries": {"split_step_window": {"mem_temp_bytes": 1}}})
+    assert [f.rule for f in findings] == ["hlo-memory-budget"], findings

@@ -286,6 +286,94 @@ def test_raw_artifact_write_negative_controls():
     assert "raw-artifact-write" not in _rules(src)
 
 
+def test_device_buffer_retention_fires():
+    # global-name binding of a device value in an event-scope module
+    src = """
+    import jax.numpy as jnp
+    _CACHE = None
+
+    def handle(x):
+        global _CACHE
+        _CACHE = jnp.zeros((1024, 1024))
+        return x
+    """
+    assert "device-buffer-retention" in _rules(
+        src, path="lightgbm_tpu/serving/mod.py")
+    # class-attribute binding: a process-lifetime pin shared across
+    # instances
+    src = """
+    import jax.numpy as jnp
+
+    class Engine:
+        pass
+
+    def warm(x):
+        Engine.scratch = jnp.ones((8, 8))
+    """
+    assert "device-buffer-retention" in _rules(
+        src, path="lightgbm_tpu/obs/mod.py")
+
+
+def test_device_buffer_retention_negative_controls():
+    # instance attributes die with their (registerable) owner — legal
+    src = """
+    import jax.numpy as jnp
+
+    class Engine:
+        def warm(self, x):
+            self.scratch = jnp.ones((8, 8))
+    """
+    assert "device-buffer-retention" not in _rules(
+        src, path="lightgbm_tpu/serving/mod.py")
+    # host numpy is not a device buffer
+    src = """
+    import numpy as np
+    _CACHE = None
+
+    def handle(x):
+        global _CACHE
+        _CACHE = np.zeros((8, 8))
+    """
+    assert "device-buffer-retention" not in _rules(
+        src, path="lightgbm_tpu/serving/mod.py")
+    # a cached jitted CALLABLE (the engine's dispatch-cache idiom)
+    # retains compiled code, not a device buffer
+    src = """
+    import jax
+    _DISPATCH = None
+
+    def dispatch():
+        global _DISPATCH
+        if _DISPATCH is None:
+            _DISPATCH = jax.jit(lambda x: x)
+        return _DISPATCH
+    """
+    assert "device-buffer-retention" not in _rules(
+        src, path="lightgbm_tpu/serving/mod.py")
+    # outside the hot/serving/obs scope the rule does not apply
+    src = """
+    import jax.numpy as jnp
+    _CACHE = None
+
+    def handle(x):
+        global _CACHE
+        _CACHE = jnp.zeros((8, 8))
+    """
+    assert "device-buffer-retention" not in _rules(
+        src, path="lightgbm_tpu/io/mod.py")
+    # pragma suppression
+    src = """
+    import jax.numpy as jnp
+    _C = None
+
+    def handle(x):
+        global _C
+        _C = jnp.zeros((8,))  # jaxlint: disable=device-buffer-retention
+    """
+    assert "device-buffer-retention" not in _rules(
+        src, path="lightgbm_tpu/serving/mod.py")
+
+
 def test_rule_table_complete():
     # every rule the walker can emit is documented (CLI --list-rules)
     assert set(AST_RULES) == {
@@ -293,7 +381,7 @@ def test_rule_table_complete():
         "env-read-at-trace", "f64-literal-in-traced",
         "jit-cache-miss-risk", "host-sync-in-loop",
         "wallclock-without-sync", "raw-artifact-write",
-        "unbounded-event-buffer",
+        "unbounded-event-buffer", "device-buffer-retention",
     }
 
 
@@ -374,16 +462,20 @@ def test_donation_drop_is_detected():
             cap=T, leaf_row=rec_mod.num_words(4, 4) + 4, interpret=True)
 
     # donating entry point: aliasing present
-    ops, has_alias, warn = _compile_entry(
+    ops, has_alias, warn, mem = _compile_entry(
         rec_mod.place_runs.lower(
             rec, comp, go, jnp.int32(0), jnp.int32(T), jnp.int32(T // 2),
             jnp.bool_(True), jnp.int32(0), jnp.int32(1),
             cap=T, leaf_row=rec_mod.num_words(4, 4) + 4, interpret=True))
     assert has_alias and not warn
+    # the same compile exposes the static memory_analysis numbers the
+    # mem_* budgets gate (ISSUE 16)
+    assert mem.get("output_bytes", 0) > 0, mem
 
     # donation dropped: no aliasing in the compiled module
     undonated = jax.jit(call_place)
-    _ops, has_alias_bad, warn_bad = _compile_entry(undonated.lower(rec))
+    _ops, has_alias_bad, warn_bad, _mem = _compile_entry(
+        undonated.lower(rec))
     measured = {"place_runs": {
         "ops": _ops, "donation": has_alias_bad and not warn_bad,
         "donation_warnings": warn_bad, "has_alias": has_alias_bad}}

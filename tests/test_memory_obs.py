@@ -1,0 +1,520 @@
+"""Tier-1 gate for the HBM-observability layer (ISSUE 16).
+
+Four contracts pinned here:
+
+* runtime accounting — the owner-attributed live-buffer census and the
+  phase-boundary watermarks (obs/memory.py) see real training/serving
+  buffers and RELEASE them (leak detectors: train-twice, 1000 serving
+  requests, hot-swap);
+* the analytic footprint model (obs/memmodel.py) agrees with the
+  measured census at pinned shapes within the documented tolerance
+  (docs/memory.md) — the evidence behind tools/hbm_budget.py's
+  100M-row wall curve;
+* OOM post-mortems — a RESOURCE_EXHAUSTED at a dispatch boundary is
+  classified, counted, and flight-recorded with census + prediction;
+* benchdiff gates hbm_peak_bytes at same shape (bench rows AND
+  per-rank multichip skew), so a quiet memory regression at a flat
+  headline exits 1.
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.obs import memmodel, memory
+
+import benchdiff  # noqa: E402  (tools/)
+
+
+def _make_booster(n=2048, F=4, bins=255, leaves=7, iters=1, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config(objective="binary", num_leaves=leaves, max_bin=bins,
+                 min_data_in_leaf=5, verbose=-1)
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata,
+                                             ds.num_data))
+    for _ in range(iters):
+        booster.train_one_iter()
+    return booster
+
+
+def _census_total() -> int:
+    gc.collect()
+    return memory.live_buffer_census()["total_bytes"]
+
+
+# -------------------------------------------------- runtime accounting
+
+def test_hbm_stats_never_raises_and_declares_support():
+    st = memory.hbm_stats()
+    for k in ("hbm_bytes_in_use", "hbm_peak_bytes", "hbm_limit_bytes",
+              "hbm_stats_supported"):
+        assert k in st, st
+    # CPU backend exposes no allocator stats; the reader must DEGRADE,
+    # not lie (hbm_stats_supported False, zeros for the gauges)
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        assert st["hbm_stats_supported"] is False
+
+
+def test_census_attributes_real_training_buffers():
+    booster = _make_booster()
+    try:
+        census = memory.live_buffer_census()
+        by_owner = census["by_owner"]
+        assert by_owner.get("dataset", {}).get("bytes", 0) > 0, by_owner
+        assert by_owner.get("scores", {}).get("bytes", 0) > 0, by_owner
+        assert census["total_bytes"] >= sum(
+            v["bytes"] for v in by_owner.values() if isinstance(v, dict))
+        # groups are (owner, dtype, shape)-keyed and sorted by -bytes
+        sizes = [g["bytes"] for g in census["groups"]]
+        assert sizes == sorted(sizes, reverse=True)
+        assert memory.last_census() is census
+    finally:
+        del booster
+
+
+def test_phase_boundary_watermarks_populate():
+    memory.reset_watermarks()
+    booster = _make_booster(iters=2)
+    try:
+        wm = memory.watermarks()
+        assert "binning" in wm and "train" in wm, sorted(wm)
+        for phase in ("binning", "train"):
+            assert wm[phase]["peak_bytes"] > 0, wm[phase]
+            assert wm[phase]["samples"] >= 1
+            # on CPU the allocator is silent -> census-fallback source
+            assert wm[phase]["source"] in ("device", "census")
+        assert memory.peak_bytes() >= max(
+            w["peak_bytes"] for w in wm.values())
+    finally:
+        del booster
+
+
+def test_memory_disabled_skips_sampling():
+    memory.reset_watermarks()
+    memory.set_enabled(False)
+    try:
+        booster = _make_booster()
+        assert memory.watermarks() == {}
+        del booster
+    finally:
+        memory.set_enabled(True)
+
+
+def test_memory_gauges_and_metrics_exposition():
+    booster = _make_booster()
+    try:
+        gauges = memory.memory_gauges()
+        assert all(k.startswith(memory.GAUGE_PREFIX) for k in gauges)
+        assert gauges["lgbm_memory_live_buffer_bytes"][0] > 0
+        assert "lgbm_memory_owner_bytes_dataset" in gauges
+        # the /metrics endpoint merge (serving/server.py api_metrics)
+        from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+        from lightgbm_tpu.serving.engine import PackedModel
+        from lightgbm_tpu.serving.server import api_metrics
+
+        engine = ServingEngine(PackedModel.from_gbdt(booster),
+                               buckets=(8,), max_batch_rows=8)
+        with MicroBatchQueue(engine, max_delay_s=0.001) as queue:
+            status, body = api_metrics(engine, queue)
+        assert status == 200
+        assert "lgbm_memory_live_buffer_bytes" in body
+        assert "lgbm_memory_owner_bytes_serving" in body
+    finally:
+        del booster
+
+
+def test_manifest_memory_section_shape():
+    booster = _make_booster()
+    try:
+        sec = memory.manifest_memory_section()
+        assert set(sec) == {"hbm", "watermarks", "census"}
+        assert sec["census"]["total_bytes"] > 0
+        assert "dataset" in sec["census"]["by_owner"]
+        assert len(sec["census"]["top"]) <= 8
+        # it rides the RunManifest (bench.py / cli.py wire it)
+        from lightgbm_tpu.obs.manifest import RunManifest
+
+        man = RunManifest.collect("test", config={}, result={},
+                                  memory=sec)
+        assert man.memory["census"]["total_bytes"] > 0
+    finally:
+        del booster
+
+
+# ----------------------------------------------------- leak detectors
+
+def test_leak_train_twice_returns_to_baseline():
+    """The train-path leak detector: two full train+teardown cycles of
+    the same config must return the census to baseline — a buffer that
+    survives its booster is exactly what the owner registry exists to
+    expose."""
+    baseline = _census_total()
+    for _ in range(2):
+        booster = _make_booster(iters=3)
+        assert _census_total() > baseline  # the buffers are visible...
+        del booster
+        after = _census_total()
+        # ...and they die with the booster (tiny scalar residue allowed)
+        assert after - baseline <= 4096, (
+            f"train leak: census {after} vs baseline {baseline}")
+
+
+def test_leak_1000_serving_requests_flat():
+    """The serving-path leak detector: 1000 requests through the
+    engine+queue stack must not grow the live set (the classic slow
+    serving leak is a per-request device buffer parked in a cache)."""
+    from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+    from lightgbm_tpu.serving.engine import PackedModel
+
+    booster = _make_booster(iters=4)
+    engine = ServingEngine(PackedModel.from_gbdt(booster),
+                           buckets=(8, 32), max_batch_rows=32)
+    rng = np.random.RandomState(0)
+    pool = rng.randn(256, 4)
+    with MicroBatchQueue(engine, max_delay_s=0.0) as queue:
+        queue.predict(pool[:8])  # warm both buckets off the meter
+        queue.predict(pool[:32])
+        start = _census_total()
+        for i in range(1000):
+            n = 1 + (i % 32)
+            queue.predict(pool[i % 200:i % 200 + n])
+        end = _census_total()
+    assert end - start <= 4096, (
+        f"serving leak: census grew {end - start} bytes over 1000 "
+        "requests")
+    del booster, engine
+
+
+def test_leak_hot_swap_frees_old_model():
+    """The swap-path leak detector: after a hot-swap the OLD model's
+    device buffers must be freed and the census serving owner must
+    account exactly the NEW model — a swap that pins both models leaks
+    a whole model per deploy."""
+    from lightgbm_tpu.serving import ServingEngine
+    from lightgbm_tpu.serving.engine import PackedModel
+
+    baseline = _census_total()
+    booster_a = _make_booster(iters=2, seed=5)
+    booster_b = _make_booster(iters=8, seed=6)  # strictly bigger model
+    pm_a = PackedModel.from_gbdt(booster_a)
+    pm_b = PackedModel.from_gbdt(booster_b)
+
+    def model_nbytes(pm):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves((pm.stacked, pm.tables))
+        return sum(int(x.nbytes) for x in leaves
+                   if isinstance(x, jax.Array))
+
+    b_bytes = model_nbytes(pm_b)
+    del booster_a, booster_b
+    engine = ServingEngine(pm_a, buckets=(8,), max_batch_rows=8)
+    del pm_a
+    gc.collect()
+    with_a = memory.live_buffer_census()["by_owner"].get(
+        "serving", {}).get("bytes", 0)
+    assert with_a > 0
+    engine.swap(pm_b)
+    del pm_b
+    gc.collect()
+    census = memory.live_buffer_census()
+    with_b = census["by_owner"].get("serving", {}).get("bytes", 0)
+    # the serving owner accounts the ACTIVE model (b), not a+b
+    assert with_b == b_bytes, (with_b, b_bytes, with_a)
+    # and the old model's buffers are really gone from the live set
+    assert census["total_bytes"] - baseline <= b_bytes + 4096, (
+        census["total_bytes"], baseline, b_bytes)
+    del engine
+
+
+# ------------------------------------------- memmodel vs measurement
+
+# the pinned validation shapes (>= 3 per the acceptance criteria):
+# n large enough that the dataset's metadata sidecars (bin bounds,
+# per-feature counts) sit inside the documented absolute tolerance
+MEMMODEL_SHAPES = (
+    dict(n=2048, F=4, bins=255, leaves=7),
+    dict(n=4096, F=8, bins=63, leaves=15),
+    dict(n=8192, F=16, bins=63, leaves=15),
+)
+
+
+@pytest.mark.parametrize("shape", MEMMODEL_SHAPES,
+                         ids=[f"n{s['n']}_F{s['F']}_b{s['bins']}"
+                              for s in MEMMODEL_SHAPES])
+def test_memmodel_agrees_with_census(shape):
+    """The analytic model's dataset and scores components match the
+    owner-attributed census within the documented tolerance
+    (docs/memory.md: max(20%, 8 KiB)) — the agreement that makes the
+    tools/hbm_budget.py curve evidence, not a guess."""
+    booster = _make_booster(n=shape["n"], F=shape["F"],
+                            bins=shape["bins"], leaves=shape["leaves"])
+    try:
+        census = memory.live_buffer_census()["by_owner"]
+        pred = memmodel.predict(rows=shape["n"], features=shape["F"],
+                                bins=shape["bins"],
+                                leaves=shape["leaves"])
+        comp = pred["components"]
+        meas_ds = census["dataset"]["bytes"]
+        assert memmodel.within_tolerance(comp["dataset"], meas_ds), (
+            f"dataset: model {comp['dataset']} vs census {meas_ds}")
+        meas_sc = census["scores"]["bytes"]
+        model_sc = comp["scores"] + comp["bag_mask"]
+        assert memmodel.within_tolerance(model_sc, meas_sc), (
+            f"scores: model {model_sc} vs census {meas_sc}")
+    finally:
+        del booster
+
+
+def test_memmodel_shapes_and_monotonicity():
+    pred = memmodel.predict(rows=10**6, features=100, bins=255,
+                            leaves=255)
+    assert pred["schema"] == memmodel.SCHEMA
+    assert set(pred["phases"]) == set(memmodel.PHASES)
+    assert pred["peak_bytes"] == max(pred["phases"].values())
+    # peak grows with rows; max_rows grows with capacity
+    smaller = memmodel.predict(rows=10**5, features=100, bins=255,
+                               leaves=255)
+    assert smaller["peak_bytes"] < pred["peak_bytes"]
+    params = dict(features=100, bins=255, leaves=255)
+    assert memmodel.max_rows(2**34, **params) > \
+        memmodel.max_rows(2**30, **params)
+    # world divides the per-shard footprint
+    sharded = memmodel.predict(rows=10**6, features=100, bins=255,
+                               leaves=255, world=8)
+    assert sharded["peak_bytes"] < pred["peak_bytes"]
+
+
+def test_memmodel_tolerance_predicate():
+    assert memmodel.within_tolerance(100, 100)
+    assert memmodel.within_tolerance(0, 8192)  # inside the abs floor
+    assert memmodel.within_tolerance(119, 100)  # inside 20% (abs floor)
+    assert not memmodel.within_tolerance(130_000, 100_000)
+    assert memmodel.within_tolerance(119_000, 100_000)
+
+
+def test_hbm_budget_tool_names_the_wall(tmp_path):
+    """tools/hbm_budget.py: the rows-vs-HBM curve renders, names the
+    first allocation to hit capacity, and exits 3 when the largest
+    requested point does not fit (the greppable planning gate)."""
+    out_json = str(tmp_path / "curve.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "hbm_budget.py"),
+         "--capacity-gib", "16", "--features", "100", "--bins", "255",
+         "--leaves", "255", "--rows", "1e6,1e8", "--json", out_json],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r.returncode == 3, r.stdout + r.stderr  # 1e8 does not fit
+    assert "max rows at this shape" in r.stdout
+    assert "first allocation to hit capacity" in r.stdout
+    with open(out_json) as fh:
+        curve = json.load(fh)
+    assert curve["schema"] == memmodel.SCHEMA
+    assert curve["max_rows"] > 0
+    assert curve["wall"]["limiting_component"] in curve["wall"][
+        "components"]
+    # a fitting sweep exits 0
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "hbm_budget.py"),
+         "--capacity-gib", "32", "--features", "20", "--rows", "1e6"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+# ------------------------------------------------- OOM post-mortems
+
+def test_classify_dispatch_error_is_oom_only():
+    assert memory.classify_dispatch_error(
+        ValueError("shape mismatch"), "train.dispatch") is None
+    ev = memory.classify_dispatch_error(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating "
+                     "1073741824 bytes"),
+        "train.dispatch",
+        predict_params=dict(rows=4096, features=8))
+    assert ev is not None
+    assert ev["where"] == "train.dispatch"
+    assert "census" in ev and "predicted_peak_bytes" in ev
+    assert ev["predicted_peak_bytes"] > 0
+
+
+def test_injected_oom_at_train_dispatch_leaves_postmortem(tmp_path):
+    """The fault-injected end-to-end: oom_dispatch at train raises a
+    RESOURCE_EXHAUSTED the classifier turns into a flight-recorder
+    dump (tail = oom) carrying census + prediction, and the counter
+    ticks.  (tools/chaos.py pins the same path as a scenario.)"""
+    from lightgbm_tpu.obs import flightrec, telemetry
+    from lightgbm_tpu.resilience import faults
+
+    booster = _make_booster()
+    flightrec.set_dump_dir(str(tmp_path))
+    flightrec.reset()
+    before = telemetry.get_telemetry().snapshot()["counters"].get(
+        "oom.train", 0)
+    faults.set_fault("oom_dispatch")
+    try:
+        with pytest.raises(faults.InjectedResourceExhausted,
+                           match="RESOURCE_EXHAUSTED"):
+            booster.train_one_iter()
+    finally:
+        faults.clear_faults()
+        flightrec.set_dump_dir(None)
+    after = telemetry.get_telemetry().snapshot()["counters"].get(
+        "oom.train", 0)
+    assert after == before + 1
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec_") and f.endswith(".json")]
+    assert dumps, "no flight-recorder dump after injected OOM"
+    with open(tmp_path / dumps[0]) as fh:
+        rec = json.load(fh)
+    assert rec["reason"] == "oom"
+    tail = rec["events"][-1]
+    assert tail["kind"] == "oom"
+    assert tail["census"]["total_bytes"] > 0
+    assert "dataset" in tail["census"]["by_owner"]
+    del booster
+
+
+def test_injected_oom_at_serve_dispatch(tmp_path):
+    from lightgbm_tpu.obs import flightrec
+    from lightgbm_tpu.resilience import faults
+    from lightgbm_tpu.serving import ServingEngine
+    from lightgbm_tpu.serving.engine import PackedModel
+
+    booster = _make_booster(iters=2)
+    engine = ServingEngine(PackedModel.from_gbdt(booster),
+                           buckets=(8,), max_batch_rows=8)
+    X = np.random.RandomState(0).randn(4, 4)
+    engine.predict(X)  # warm: the injected fault must hit dispatch only
+    flightrec.set_dump_dir(str(tmp_path))
+    flightrec.reset()
+    faults.set_fault("oom_dispatch")
+    try:
+        with pytest.raises(faults.InjectedResourceExhausted,
+                           match="RESOURCE_EXHAUSTED"):
+            engine.predict(X)
+    finally:
+        faults.clear_faults()
+        flightrec.set_dump_dir(None)
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec_") and f.endswith(".json")]
+    assert dumps
+    with open(tmp_path / dumps[0]) as fh:
+        tail = json.load(fh)["events"][-1]
+    assert tail["kind"] == "oom" and tail["where"] == "serve.dispatch"
+    assert tail["shape"].get("bucket") == 8
+    del booster, engine
+
+
+# --------------------------------------------------- benchdiff gates
+
+def _norm_bench(tmp_path, name: str, hbm) -> dict:
+    """A raw bench.py row written to disk and run through the REAL
+    normalize() path (the hbm_peak_bytes passthrough under test)."""
+    row = {"metric": "s_per_tree", "value": 0.5, "unit": "s/tree",
+           "train_auc": 0.9}
+    if hbm:
+        row["hbm_peak_bytes"] = int(hbm)
+    p = tmp_path / f"{name}.json"
+    p.write_text(json.dumps(row))
+    return benchdiff.normalize(str(p))
+
+
+def test_benchdiff_fails_hbm_regression_at_flat_headline(tmp_path):
+    """+20% device memory at the same shape with an UNCHANGED headline
+    must be a regression (exit-1 class), and -20% an improvement — the
+    quiet-memory-creep gate, both directions pinned."""
+    base = benchdiff.diff(_norm_bench(tmp_path, "a", 10**9),
+                          _norm_bench(tmp_path, "b", 10**9))
+    assert not base["regressions"], base["regressions"]
+    worse = benchdiff.diff(_norm_bench(tmp_path, "c", 10**9),
+                           _norm_bench(tmp_path, "d", int(1.2 * 10**9)))
+    assert any("hbm_peak_bytes" in r and "device-memory regression" in r
+               for r in worse["regressions"]), worse["regressions"]
+    better = benchdiff.diff(_norm_bench(tmp_path, "e", int(1.2 * 10**9)),
+                            _norm_bench(tmp_path, "f", 10**9))
+    assert not better["regressions"], better["regressions"]
+    assert any("hbm_peak_bytes" in s for s in better["improvements"])
+    # losing the measurement entirely is a coverage warning, not silence
+    lost = benchdiff.diff(_norm_bench(tmp_path, "g", 10**9),
+                          _norm_bench(tmp_path, "h", None))
+    assert any("hbm_peak_bytes" in w for w in lost["warnings"]), lost
+
+
+def _norm_multichip(tmp_path, name: str, rank_hbm) -> dict:
+    raw = {
+        "schema": "lightgbm-tpu/multichip-bench/v1",
+        "world": len(rank_hbm),
+        "result": {"value": 0.5, "unit": "s", "trees": 8},
+        "ranks": [{"process_index": i, "hbm_peak_bytes": h,
+                   "counters": {}, "spans": {}, "reservoirs": {}}
+                  for i, h in enumerate(rank_hbm)],
+        "merged": {"counters": {}, "spans": {}, "reservoirs": {}},
+        "skew": {"spans": {}, "reservoirs": {}},
+        "stragglers": [],
+        "extra": {},
+    }
+    p = tmp_path / f"{name}.json"
+    p.write_text(json.dumps(raw))
+    return benchdiff.normalize(str(p))
+
+
+def test_benchdiff_multichip_memory_skew_gate(tmp_path):
+    """Per-rank memory skew appearing where the baseline was flat is a
+    regression (one rank ballooning is how a sharding bug looks before
+    it OOMs); an already-skewed baseline downgrades to a warning."""
+    flat = _norm_multichip(tmp_path, "flat", [10**9, 10**9])
+    skewed = _norm_multichip(tmp_path, "skew",
+                             [10**9, int(1.5 * 10**9)])
+    d = benchdiff.diff_multichip(flat, skewed)
+    assert any("memory skew" in r for r in d["regressions"]), d
+    d2 = benchdiff.diff_multichip(skewed, skewed)
+    assert not any("memory skew" in r for r in d2["regressions"]), d2
+    assert any("already skewed" in w for w in d2["warnings"]), d2
+    # the artifact-level peak (max over ranks) still gets the +/-15%
+    # same-shape gate
+    mild = _norm_multichip(tmp_path, "mild",
+                           [10**9, int(1.3 * 10**9)])
+    d3 = benchdiff.diff_multichip(flat, mild)
+    assert any("device-memory regression" in r
+               for r in d3["regressions"]), d3
+
+
+def test_rank_snapshot_carries_hbm_and_table_shows_skew():
+    """The dist layer: every rank snapshot stamps hbm_peak_bytes, the
+    manifest ranks[] passes it through, and the shared rank table
+    (tools/rank_report.py + the dryrun MULTICHIP tail) renders the
+    memory column + skew line beside the time skew."""
+    from lightgbm_tpu.obs import dist, telemetry
+
+    snaps = [dist.rank_snapshot(telemetry.Telemetry(), rank=r, world=2,
+                                extra={"hbm_peak_bytes": hbm})
+             for r, hbm in ((0, 100 * 2**20), (1, 130 * 2**20))]
+    ranks = dist.ranks_section(snaps)
+    assert [r["hbm_peak_bytes"] for r in ranks] == [100 * 2**20,
+                                                    130 * 2**20]
+    merged = dist.merge_snapshots(snaps)
+    lines = dist.render_rank_table(merged, ranks)
+    assert any("hbm_peak MiB" in ln for ln in lines)
+    skew_lines = [ln for ln in lines if ln.startswith("memory skew")]
+    assert skew_lines and "+30.0%" in skew_lines[0], lines
+    # and benchdiff reads the same artifact shape end-to-end
+    art = dist.multichip_artifact(merged, snaps, result={"trees": 2})
+    assert [r["hbm_peak_bytes"] for r in art["ranks"]] == [
+        100 * 2**20, 130 * 2**20]

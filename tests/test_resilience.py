@@ -502,7 +502,8 @@ def test_chaos_dryrun_smoke():
     assert summary["failures"] == 0
     assert set(summary["results"]) == {
         "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
-        "serve_swap", "serve_fail_write", "desync", "straggler"}
+        "serve_swap", "serve_fail_write", "desync", "straggler",
+        "oom_dispatch"}
     # ISSUE 14: the preemption and refused-swap scenarios now also
     # assert a flight-recorder post-mortem (atomic + checksum sidecar,
     # tail = the triggering event) — pinned via the scenario details so
@@ -518,6 +519,15 @@ def test_chaos_dryrun_smoke():
         summary["results"]["desync"]["detail"]
     assert "attributed to rank 1" in \
         summary["results"]["straggler"]["detail"]
+    # ISSUE 16: the OOM post-mortem scenario pins tail = ``oom`` and
+    # that the dump carries BOTH the live-buffer census (with owner
+    # attribution) and the analytic memmodel prediction (obs/memory.py)
+    assert "flight-recorder dump (tail=oom)" in \
+        summary["results"]["oom_dispatch"]["detail"]
+    assert "carrying census" in \
+        summary["results"]["oom_dispatch"]["detail"]
+    assert "memmodel predicted peak" in \
+        summary["results"]["oom_dispatch"]["detail"]
 
 
 @pytest.mark.slow

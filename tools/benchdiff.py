@@ -122,6 +122,17 @@ def _normalize_multichip(raw: dict, rec: dict) -> dict:
         k: counters[k] for k in sorted(counters)
         if k.startswith(("collective_ops.op.", "collective_site."))}
     rec["stragglers"] = raw.get("stragglers") or []
+    # per-rank device-memory peaks (obs/memory.py): the artifact-level
+    # hbm_peak_bytes is the worst rank — the one the next OOM kills
+    rank_hbm = {}
+    for r in raw.get("ranks") or []:
+        if r.get("hbm_peak_bytes"):
+            rank_hbm[r.get("process_index")] = int(r["hbm_peak_bytes"])
+    extra_hbm = (raw.get("extra") or {}).get("hbm_peak_bytes")
+    peak = max(rank_hbm.values(), default=0) or int(extra_hbm or 0)
+    if peak:
+        rec["hbm_peak_bytes"] = peak
+        rec["rank_hbm_peak_bytes"] = rank_hbm
     if rec.get("value") in (None, 0, 0.0):
         raise ValueError(
             f"{rec['path']}: multichip artifact has no usable headline "
@@ -145,6 +156,11 @@ def normalize(path: str) -> dict:
         rec["sha"] = (raw.get("git") or {}).get("sha")
         rec["per_tree"] = raw.get("per_tree") or {}
         rec["warmup"] = raw.get("warmup") or {}
+        # memory section (obs/memory.py manifest_memory_section):
+        # hbm peak is gateable like the headline
+        hbm = (raw.get("memory") or {}).get("hbm") or {}
+        if hbm.get("hbm_peak_bytes"):
+            rec["hbm_peak_bytes"] = int(hbm["hbm_peak_bytes"])
         # northstar manifests carry the headline under another key
         if "value" not in row and "steady_sec_per_tree" in row:
             row["value"] = row["steady_sec_per_tree"]
@@ -163,7 +179,8 @@ def normalize(path: str) -> dict:
     for k in ("metric", "value", "unit", "vs_baseline", "platform",
               "growth", "train_auc", "valid_auc", "knobs", "error",
               "warmup_iters", "warm_trees_discarded", "compile_stable",
-              "compiles_warmup", "compiles_timed", "timed_trees"):
+              "compiles_warmup", "compiles_timed", "timed_trees",
+              "hbm_peak_bytes"):
         if k in row:
             rec[k] = row[k]
     if "phases" in row and not rec["phases"]:
@@ -176,6 +193,33 @@ def normalize(path: str) -> dict:
 
 def _pct(old: float, new: float) -> float:
     return (new - old) / old * 100.0 if old else float("inf")
+
+
+def _diff_hbm(old: dict, new: dict, regressions: list, warnings: list,
+              improvements: list, headline_pct: float) -> None:
+    """Device-memory gate, shared by training and multichip diffs: at
+    the same shape, ``hbm_peak_bytes`` growing past the headline
+    threshold is a regression EVEN when the time headline stays flat —
+    a +15% peak at 100M rows is the next OOM (ROADMAP items 3/4), and
+    time gates alone would wave it through."""
+    oh = int(old.get("hbm_peak_bytes") or 0)
+    nh = int(new.get("hbm_peak_bytes") or 0)
+    if oh <= 0 and nh <= 0:
+        return
+    if oh <= 0 or nh <= 0:
+        side = "old" if nh else "new"
+        warnings.append(
+            f"hbm_peak_bytes present only in the {side} artifact — "
+            "memory coverage changed between the two runs")
+        return
+    d = _pct(oh, nh)
+    if d >= headline_pct:
+        regressions.append(
+            f"hbm_peak_bytes {oh} -> {nh} (+{d:.1f}%, threshold "
+            f"+{headline_pct:.0f}%) — device-memory regression at "
+            "same shape")
+    elif d <= -headline_pct:
+        improvements.append(f"hbm_peak_bytes {oh} -> {nh} ({d:.1f}%)")
 
 
 def diff_serving(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
@@ -333,6 +377,26 @@ def diff_multichip(old: dict, new: dict,
                 f"cross-rank skew '{name}' {o:.4f}s -> {n:.4f}s "
                 f"({d:.1f}%)")
 
+    _diff_hbm(old, new, regressions, warnings, improvements,
+              headline_pct)
+    # per-rank memory skew: a rank whose peak diverges from its peers
+    # is the data-balance analog of a time straggler
+    orh = old.get("rank_hbm_peak_bytes") or {}
+    nrh = new.get("rank_hbm_peak_bytes") or {}
+    if len(nrh) >= 2:
+        mx, mn = max(nrh.values()), min(nrh.values())
+        if mn > 0 and _pct(mn, mx) >= phase_pct:
+            omx, omn = (max(orh.values()), min(orh.values())) \
+                if len(orh) >= 2 else (0, 0)
+            was_skewed = omn > 0 and _pct(omn, omx) >= phase_pct
+            who = max(nrh, key=lambda r: nrh[r])
+            msg = (f"per-rank hbm_peak_bytes skew: min {mn}, max {mx} "
+                   f"(+{_pct(mn, mx):.1f}%; heaviest rank {who})")
+            if was_skewed:
+                warnings.append(msg + " — already skewed in baseline")
+            else:
+                regressions.append("memory skew appeared: " + msg)
+
     oc = old.get("collective_census") or {}
     nc = new.get("collective_census") or {}
     if oc and nc and oc != nc:
@@ -464,6 +528,9 @@ def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
             d = float(new[k]) - float(old[k])
             if d < -AUC_ABS:
                 regressions.append(f"{k} {old[k]} -> {new[k]} ({d:+.4f})")
+
+    _diff_hbm(old, new, regressions, warnings, improvements,
+              headline_pct)
 
     return {"headline": headline, "regressions": regressions,
             "warnings": warnings, "improvements": improvements}

@@ -34,6 +34,11 @@ status; the fault matrix lives in docs/resilience.md):
   before the barrier (``delay_collective:1:<ms>``); rank 0's
   barrier-wait must absorb the delay, and the merged-snapshot skew
   must attribute the straggle to rank 1.
+* ``oom_dispatch`` — an injected ``RESOURCE_EXHAUSTED`` at the train
+  dispatch boundary (``oom_dispatch`` fault); the classifier must leave
+  a flight-recorder post-mortem (tail = ``oom``) carrying the last
+  live-buffer census AND the analytic memmodel prediction for the
+  failing shape (obs/memory.py, docs/memory.md), then re-raise.
 
 Modes:
 
@@ -71,7 +76,7 @@ sys.path.insert(0, ROOT)
 
 SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
              "collective", "serve_swap", "serve_fail_write",
-             "desync", "straggler")
+             "desync", "straggler", "oom_dispatch")
 
 
 def log(msg: str) -> None:
@@ -427,6 +432,62 @@ def scenario_straggler_inproc(tmp: str) -> str:
             "rank 1 in the merged snapshot")
 
 
+def scenario_oom_dispatch_inproc(tmp: str) -> str:
+    """Memory fault scenario (obs/memory.py): an injected
+    ``RESOURCE_EXHAUSTED`` at the train dispatch boundary must be
+    classified as an OOM and leave a flight-recorder post-mortem whose
+    tail (kind ``oom``) carries both the last live-buffer census and
+    the memmodel prediction for the failing shape — the two halves of
+    the "what was resident vs what did the model expect" answer."""
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.obs import flightrec
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.resilience import faults
+
+    rng = np.random.RandomState(21)
+    X = rng.randn(256, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config(objective="binary", num_leaves=7, min_data_in_leaf=5,
+                 verbose=-1)
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), config=cfg)
+    booster = GBDT(cfg, ds, create_objective(cfg, ds.metadata,
+                                             ds.num_data))
+    booster.train_one_iter()  # one clean iteration: census has owners
+
+    flightrec.set_dump_dir(tmp)
+    flightrec.reset()
+    faults.set_fault("oom_dispatch")
+    try:
+        booster.train_one_iter()
+        raise AssertionError("injected RESOURCE_EXHAUSTED was swallowed")
+    except faults.InjectedResourceExhausted as e:
+        assert "RESOURCE_EXHAUSTED" in str(e), str(e)
+    finally:
+        faults.clear_faults()
+    _assert_flightrec_dump(tmp, "oom", "oom")
+    dumps = [os.path.join(tmp, f) for f in os.listdir(tmp)
+             if f.startswith("flightrec_") and f.endswith(".json")]
+    with open(max(dumps, key=os.path.getmtime)) as fh:
+        tail = json.load(fh)["events"][-1]
+    assert tail["where"] == "train.dispatch", tail["where"]
+    census = tail.get("census") or {}
+    owners = census.get("by_owner") or {}
+    assert census.get("total_bytes", 0) > 0 and "dataset" in owners, (
+        f"post-mortem census carries no owner attribution: {census}")
+    assert tail.get("predicted_peak_bytes"), (
+        "post-mortem carries no memmodel prediction")
+    return ("injected RESOURCE_EXHAUSTED at train dispatch -> "
+            "flight-recorder dump (tail=oom) carrying census "
+            f"({census['total_bytes']} B live, owners "
+            f"{sorted(owners)}) + memmodel predicted peak "
+            f"{tail['predicted_peak_bytes']} B")
+
+
 def scenario_collective_inproc(tmp: str) -> str:
     from lightgbm_tpu.resilience import faults
     from lightgbm_tpu.resilience.retry import guarded_collective
@@ -569,6 +630,7 @@ def main() -> int:
         run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
         run("desync", scenario_desync_inproc, tmp)
         run("straggler", scenario_straggler_inproc, tmp)
+        run("oom_dispatch", scenario_oom_dispatch_inproc, tmp)
     else:
         run("kill_resume", scenario_kill_resume_subproc, tmp, args.trees,
             args.seed)
@@ -587,6 +649,7 @@ def main() -> int:
         # container cannot run multiprocess collectives)
         run("desync", scenario_desync_inproc, tmp)
         run("straggler", scenario_straggler_inproc, tmp)
+        run("oom_dispatch", scenario_oom_dispatch_inproc, tmp)
 
     summary = {"mode": "dryrun" if args.dryrun else "subprocess",
                "seed": args.seed, "failures": failures,

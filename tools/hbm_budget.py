@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""hbm_budget: the 100M-row planning tool — where is the HBM wall?
+
+Evaluates the analytic footprint model (``lightgbm_tpu/obs/memmodel.py``,
+equations in docs/memory.md) across a rows sweep at a fixed training
+shape and answers, WITHOUT touching a device:
+
+* the predicted peak-resident bytes (and which training phase peaks)
+  at each row count;
+* ``max_rows`` — the largest dataset that fits the given capacity;
+* WHICH allocation hits the wall first (the limiting component in the
+  peak phase) — the number that tells you whether the fix is fewer
+  bins, shallower trees, a different routing mode, or more chips.
+
+The model is validated against the runtime live-buffer census in
+tier-1 (tests/test_memory_obs.py, tolerance pinned in docs/memory.md),
+so the curve printed here is evidence-backed, not a guess.
+
+Usage:
+    python tools/hbm_budget.py --capacity-gib 16 --features 100
+    python tools/hbm_budget.py --capacity-gib 16 --features 100 \
+        --bins 255 --leaves 255 --world 8 --routing prefix \
+        --rows 1e6,1e7,1e8 --json curve.json
+
+Exit codes: 0 = the largest requested row point fits, 3 = it does not
+(greppable as a capacity-planning gate); 2 = bad arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from lightgbm_tpu.obs import memmodel  # noqa: E402
+
+DEFAULT_ROWS = "1e5,1e6,1e7,5e7,1e8,2e8"
+
+
+def _parse_rows(spec: str):
+    try:
+        rows = [int(float(tok)) for tok in spec.split(",") if tok.strip()]
+    except ValueError as e:
+        raise ValueError(f"bad --rows {spec!r}: {e}") from None
+    if not rows or any(r < 1 for r in rows):
+        raise ValueError(f"bad --rows {spec!r}: need positive row counts")
+    return sorted(set(rows))
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def render(curve: dict) -> list:
+    """The human-readable report (shared with --json consumers via the
+    same curve dict)."""
+    p = curve["params"]
+    lines = [
+        f"hbm_budget: capacity {_fmt_bytes(curve['capacity_bytes'])}"
+        f" | features={p['features']} bins={p['bins']}"
+        f" leaves={p['leaves']} num_class={p['num_class']}"
+        f" world={p['world']} routing={p['routing']}"
+        f" hist_prec={p['hist_prec']}",
+        f"{'rows':>12}  {'predicted peak':>14}  {'peak phase':<12} fits",
+    ]
+    for pt in curve["points"]:
+        lines.append(
+            f"{pt['rows']:>12,}  {_fmt_bytes(pt['peak_bytes']):>14}  "
+            f"{pt['peak_phase']:<12} {'yes' if pt['fits'] else 'NO'}")
+    wall = curve["wall"]
+    lines.append(
+        f"max rows at this shape: {curve['max_rows']:,} "
+        f"(global rows across world={p['world']})")
+    lines.append(
+        f"the wall: phase '{wall['peak_phase']}' — first allocation to "
+        f"hit capacity is '{wall['limiting_component']}' "
+        f"({_fmt_bytes(wall['limiting_bytes'])} at the largest fitting "
+        "shape)")
+    comps = ", ".join(f"{k}={_fmt_bytes(v)}"
+                      for k, v in sorted(wall["components"].items(),
+                                         key=lambda kv: -kv[1]) if v)
+    lines.append(f"components at the wall: {comps}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--capacity-gib", type=float, default=16.0,
+                    help="per-device HBM capacity (GiB; v4 HBM=32, "
+                    "v2/v3=16; default 16)")
+    ap.add_argument("--capacity-bytes", type=int, default=0,
+                    help="exact capacity in bytes (overrides "
+                    "--capacity-gib)")
+    ap.add_argument("--rows", default=DEFAULT_ROWS,
+                    help=f"comma list of row counts (default "
+                    f"{DEFAULT_ROWS}; 1e8 is the paper's wall)")
+    ap.add_argument("--features", type=int, default=100)
+    ap.add_argument("--bins", type=int, default=255)
+    ap.add_argument("--leaves", type=int, default=31)
+    ap.add_argument("--num-class", type=int, default=1)
+    ap.add_argument("--world", type=int, default=1,
+                    help="data-parallel shards (rows divide across them)")
+    ap.add_argument("--routing", choices=("prefix", "onehot", "order"),
+                    default="prefix")
+    ap.add_argument("--hist-prec", choices=("float32", "float64"),
+                    default="float32")
+    ap.add_argument("--json", help="also write the curve dict here")
+    args = ap.parse_args(argv)
+
+    capacity = args.capacity_bytes or int(args.capacity_gib * 2**30)
+    try:
+        rows = _parse_rows(args.rows)
+    except ValueError as e:
+        print(f"hbm_budget: {e}", file=sys.stderr)
+        return 2
+
+    curve = memmodel.rows_curve(
+        capacity, rows, features=args.features, bins=args.bins,
+        leaves=args.leaves, num_class=args.num_class, world=args.world,
+        routing=args.routing, hist_prec=args.hist_prec)
+    for line in render(curve):
+        print(line)
+    if args.json:
+        from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+        atomic_write_json(args.json, curve)
+    return 0 if curve["points"][-1]["fits"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

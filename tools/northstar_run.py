@@ -63,16 +63,20 @@ def make_split_data():
 
 
 def hbm_stats() -> dict:
-    import jax
+    """Shared device-memory reader (obs/memory.py) — same output keys
+    as the old ad-hoc memory_stats() call; on backends without
+    allocator stats the peak falls back to the census high-water mark
+    so a CPU northstar run still reports a real number."""
+    from lightgbm_tpu.obs import memory as obs_memory
 
-    try:
-        ms = jax.local_devices()[0].memory_stats() or {}
-        return {
-            "hbm_peak_bytes": int(ms.get("peak_bytes_in_use", 0)),
-            "hbm_limit_bytes": int(ms.get("bytes_limit", 0)),
-        }
-    except Exception as e:
-        return {"hbm_stats_error": f"{type(e).__name__}: {str(e)[:120]}"}
+    st = obs_memory.hbm_stats()
+    if st.get("hbm_stats_error"):
+        return {"hbm_stats_error": st["hbm_stats_error"]}
+    return {
+        "hbm_peak_bytes": int(st["hbm_peak_bytes"]
+                              or obs_memory.peak_bytes()),
+        "hbm_limit_bytes": int(st["hbm_limit_bytes"]),
+    }
 
 
 def run_ours(Xtr, ytr, Xva, yva) -> dict:
