@@ -72,7 +72,7 @@ from .callback import (  # noqa: F401
     record_evaluation,
     reset_parameter,
 )
-from .engine import CVBooster, cv, train  # noqa: F401
+from .engine import CVBooster, cv, train, train_many  # noqa: F401
 from .sklearn import (  # noqa: F401
     LGBMClassifier,
     LGBMModel,
@@ -89,6 +89,7 @@ __all__ = [
     "Booster",
     "LightGBMError",
     "train",
+    "train_many",
     "cv",
     "CVBooster",
     "print_evaluation",

@@ -190,6 +190,35 @@ def _measure_grow_tree_serial() -> dict:
             "has_alias": has_alias, "memory": mem}
 
 
+_FOREST_LANES = 4
+
+
+def _measure_grow_forest_batched() -> dict:
+    """The forest-batched grower (learners/forest.py, explicit batched
+    loop): one traced program advancing _FOREST_LANES independent trees
+    — the multiclass / cv-fold / train_many dispatch.  Audited at the
+    same (n, F, bins, leaves) pin as grow_tree_serial so the two
+    entries' op counts stay comparable lane-for-lane."""
+    import jax.numpy as jnp
+
+    from ..learners.forest import make_grow_forest, stack_learner_params
+
+    bins_T, grad, hess, bag, fmask, nbpf, iscat, params = _grow_inputs()
+    B = _FOREST_LANES
+    gf = make_grow_forest(_B, _L, "batched")
+    lowered = gf.lower(
+        bins_T,
+        jnp.broadcast_to(grad, (B, _N)),
+        jnp.broadcast_to(hess, (B, _N)),
+        jnp.broadcast_to(bag, (B, _N)),
+        jnp.broadcast_to(fmask, (B, _F)),
+        nbpf, iscat,
+        stack_learner_params([params] * B))
+    ops, has_alias, dwarn, mem = _compile_entry(lowered)
+    return {"ops": ops, "donation": None, "donation_warnings": dwarn,
+            "has_alias": has_alias, "memory": mem}
+
+
 def _split_step_inputs():
     import jax.numpy as jnp
     import numpy as np
@@ -364,6 +393,7 @@ def _measure_post_grow_step() -> dict:
 
 _ENTRY_MEASURERS = {
     "grow_tree_serial": _measure_grow_tree_serial,
+    "grow_forest_batched": _measure_grow_forest_batched,
     "split_step_window": _measure_split_step_window,
     "split_step_record_chain": _measure_split_step_record_chain,
     "place_runs": _measure_place_runs,

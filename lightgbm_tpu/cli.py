@@ -392,6 +392,41 @@ def _train_loop(cfg: Config, booster: GBDT, valid_names: List[str],
     return None
 
 
+def run_train_many(cfg: Config, params: Dict[str, str]) -> None:
+    """``task=train_many``: N independent models, one shared binned
+    dataset, every boosting round advanced as ONE batched forest
+    dispatch (engine.train_many; docs/forest_batching.md).  Model i
+    trains with master seed ``seed + i`` — a seed-ensemble sweep — and
+    saves to ``<output_model>.<i>``."""
+    from .analysis.recompile import compile_counter
+    from .basic import Dataset
+    from .engine import train_many
+
+    compile_counter()
+    if cfg.num_models < 1:
+        Log.fatal("num_models must be >= 1 for task=train_many")
+    base = {
+        k: v for k, v in params.items()
+        if k not in ("task", "num_models", "data", "output_model")
+    }
+    plist = []
+    for i in range(cfg.num_models):
+        p = dict(base)
+        p["seed"] = cfg.seed + i
+        plist.append(p)
+    t0 = time.perf_counter()
+    ds = Dataset(cfg.data, params=dict(base))
+    boosters = train_many(plist, ds, num_boost_round=cfg.num_iterations)
+    Log.info(
+        f"Finished training {len(boosters)} models in "
+        f"{time.perf_counter() - t0:.6f} seconds"
+    )
+    for i, bst in enumerate(boosters):
+        path = f"{cfg.output_model}.{i}"
+        bst.save_model(path)
+        Log.info(f"Saved model {i} ({bst.num_trees()} trees) to {path}")
+
+
 def run_predict(cfg: Config) -> None:
     """Application::Predict (application.cpp:242-256)."""
     from .basic import Booster
@@ -445,6 +480,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         Log.reset_log_level(cfg.verbose)
         if cfg.task == "train":
             run_train(cfg)
+        elif cfg.task == "train_many":
+            run_train_many(cfg, params)
         elif cfg.task in ("predict", "prediction", "test"):
             run_predict(cfg)
         elif cfg.task == "serve":

@@ -147,6 +147,11 @@ class Config:
 
     # ---- task / IO (IOConfig, config.h:91-135)
     task: str = "train"
+    # task=train_many: number of independent models trained on the one
+    # shared binned dataset as a single batched program (engine.
+    # train_many / learners/forest.py); model i gets seed+i so the
+    # sweep is a seed-ensemble by default
+    num_models: int = 2
     data: str = ""
     valid_data: List[str] = dataclasses.field(default_factory=list)
     max_bin: int = 256
@@ -229,6 +234,14 @@ class Config:
     # to [2, num_leaves]) and recomputes evicted parents from their
     # contiguous partition range.
     histogram_pool_size: float = -1.0
+    # TPU extension: forest-level batched dispatch (learners/forest.py).
+    # "auto" batches the K multiclass trees of an iteration into one
+    # launch when the shape is small enough to win on dispatch overhead
+    # (num_data <= LGBM_TPU_FOREST_MAX_ROWS, default 2048); "on" forces
+    # batching regardless of shape; "off" keeps the sequential per-tree
+    # grow loop.  Batched trees are bitwise-identical to sequential ones
+    # (docs/forest_batching.md).
+    forest_batching: str = "auto"
 
     # ---- boosting (BoostingConfig, config.h:192-221)
     boosting_type: str = "gbdt"
@@ -371,6 +384,10 @@ class Config:
             raise ValueError(f"Unknown hist_impl: {self.hist_impl!r}")
         if self.hist_dtype not in ("float32", "float64"):
             raise ValueError(f"Unknown hist_dtype: {self.hist_dtype!r}")
+        if self.forest_batching not in ("auto", "on", "off"):
+            raise ValueError(
+                f"Unknown forest_batching: {self.forest_batching!r}"
+            )
         if self.max_bin < 2:
             raise ValueError("max_bin must be >= 2")
         # value-range CHECKs from the reference (config.cpp:275-307)

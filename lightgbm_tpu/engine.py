@@ -16,7 +16,7 @@ import numpy as np
 
 from . import callback
 from .basic import Booster, Dataset, LightGBMError
-from .config import key_alias_transform
+from .config import Config, key_alias_transform
 
 
 def train(
@@ -201,6 +201,108 @@ def _make_n_folds(full_data: Dataset, nfold: int, params: Dict[str, Any],
     return folds
 
 
+def _cv_can_share_bins(params, inner, fpreproc, fobj) -> bool:
+    """May cv() train every fold on the shared full binned matrix with a
+    base row mask instead of per-fold subsets?  Requires that NOTHING in
+    the training pipeline looks at global (unmasked) data statistics:
+
+    * fpreproc/fobj — arbitrary user code sees the dataset shape
+    * query grouping — fold masks are query-granular, and the ranking
+      objectives normalize per query over the raw row layout
+    * bagging ANDs with the base mask fine, but the draw itself is over
+      all n rows — a subset-trained fold draws over n_train rows with
+      the same seed, so the realized masks diverge
+    * is_unbalance / scale_pos_weight derive class weights from the
+      WHOLE label vector at objective init
+    * dart rescales against drop-set predictions whose normalization
+      constants are global
+
+    Everything else is per-row math, where masked rows are exact no-ops
+    (set_base_row_mask's parity contract).
+    """
+    if fpreproc is not None or fobj is not None:
+        return False
+    if inner.metadata.query_boundaries is not None:
+        return False
+    try:
+        probe = Config.from_dict(dict(params))
+    except Exception:
+        return False
+    return (
+        probe.boosting_type == "gbdt"
+        and (probe.bagging_fraction >= 1.0 or probe.bagging_freq <= 0)
+        and not probe.is_unbalance
+        and probe.scale_pos_weight == 1.0
+    )
+
+
+def train_many(
+    params_list: List[Dict[str, Any]],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+) -> List[Booster]:
+    """Train N independent models on ONE shared binned dataset, batched
+    so that each boosting round advances every model's trees in a single
+    forest dispatch (models/gbdt.py train_forest_round) — the
+    multi-tenant "B small models sharing one chip" product shape.
+
+    ``params_list`` holds one param dict per model.  Binning comes from
+    ``train_set`` (bin once); per-model params may vary freely across
+    the lane-compatible knobs (learning_rate, lambda_l1/l2,
+    min_data_in_leaf, min_sum_hessian_in_leaf, min_gain_to_split,
+    max_depth, feature_fraction, bagging, seeds, objective — even
+    num_class), but ``num_leaves`` and ``max_bin`` fix the traced
+    program shape and must match across models (ValueError otherwise).
+
+    Models whose configs cannot batch (forest_batching=off, non-serial
+    learner, f64 histograms, histogram pool, or auto-gated row count)
+    fall back to sequential per-model rounds — same results, no shared
+    dispatch.  Returns the boosters in input order.
+    """
+    from .models.gbdt import train_forest_round
+
+    if not params_list:
+        return []
+    # merge dataset params before binning, exactly as train() does, so
+    # max_bin etc. reach the binner; the binning-relevant knobs must
+    # agree across models anyway (the _num_bins check below), so the
+    # first model's params speak for the sweep
+    merged = dict(train_set.params or {})
+    merged.update(key_alias_transform(dict(params_list[0])))
+    train_set.params = merged
+    train_set.construct()
+    boosters = []
+    for p in params_list:
+        tparams = key_alias_transform(dict(p))
+        boosters.append(Booster(params=tparams, train_set=train_set))
+    gb = [b._gbdt for b in boosters]
+    ref = gb[0]
+    for g in gb[1:]:
+        if g.max_leaves != ref.max_leaves or g._num_bins != ref._num_bins:
+            raise ValueError(
+                "train_many: num_leaves and max_bin must match across "
+                "models (they fix the traced program shape); vary "
+                "learning-rate/regularization/sampling knobs per model "
+                "instead"
+            )
+    batched = all(g._forest_eligible() for g in gb)
+    done = [False] * len(gb)
+    for _ in range(num_boost_round):
+        idx = [i for i, d in enumerate(done) if not d]
+        if not idx:
+            break
+        if batched:
+            stops = train_forest_round([gb[i] for i in idx])
+            for i, stop in zip(idx, stops):
+                done[i] = bool(stop)
+        else:
+            for i in idx:
+                done[i] = bool(boosters[i].update())
+    for b in boosters:
+        b.finish_lagged_stop()
+    return boosters
+
+
 def _agg_cv_result(raw_results):
     """Mean/std across folds (engine.py:266-280)."""
     cvmap = collections.OrderedDict()
@@ -252,20 +354,54 @@ def cv(
         train_set.categorical_feature = list(categorical_feature)
 
     full_data = train_set
-    full_data.construct()
+    inner = full_data.construct()
     folds = _make_n_folds(full_data, nfold, params, seed, stratified, shuffle)
 
+    share_bins = _cv_can_share_bins(params, inner, fpreproc, fobj)
     cvfolds = CVBooster()
+    shared_all = True
     for train_idx, test_idx in folds:
-        tr = full_data.subset(np.sort(train_idx))
         te = full_data.subset(np.sort(test_idx))
         tparams = dict(params)
-        if fpreproc is not None:
-            tr, te, tparams = fpreproc(tr, te, tparams.copy())
-        tr.params.update(tparams)
-        bst = Booster(params=tparams, train_set=tr)
+        bst = None
+        if share_bins:
+            # bin-once path: every fold booster trains on the SHARED
+            # full binned matrix with the fold's train rows as a base
+            # row mask — no per-fold binned copy, no per-fold device
+            # transfer, ONE grow-program shape for all folds (so the
+            # fold loop below can batch through train_forest_round).
+            # Trees/metrics are bitwise the subset-trained ones
+            # (gbdt.set_base_row_mask explains the contract); the
+            # set_base_row_mask guard rejects non-canonical growers,
+            # falling back to the subset path.
+            cand = Booster(params=tparams, train_set=full_data)
+            mask = np.zeros(full_data.num_data(), np.float32)
+            mask[np.sort(train_idx)] = 1.0
+            try:
+                cand._gbdt.set_base_row_mask(mask)
+                bst = cand
+            except (ValueError, AttributeError):
+                bst = None
+        if bst is None:
+            shared_all = False
+            tr = full_data.subset(np.sort(train_idx))
+            if fpreproc is not None:
+                tr, te, tparams = fpreproc(tr, te, tparams.copy())
+            tr.params.update(tparams)
+            bst = Booster(params=tparams, train_set=tr)
         bst.add_valid(te, "valid")
         cvfolds.append(bst)
+
+    # fold-level forest batching: with the bin-once path active on every
+    # fold the per-iteration grow work is shape-identical across folds —
+    # ONE batched dispatch advances all nfold trees (models/gbdt.py
+    # train_forest_round)
+    batch_folds = (
+        share_bins and shared_all and fobj is None
+        and all(b._gbdt._forest_eligible() for b in cvfolds.boosters)
+    )
+    if batch_folds:
+        from .models.gbdt import train_forest_round
 
     results = collections.defaultdict(list)
     cbs = list(dict.fromkeys(callbacks or []))  # ordered dedupe, see train()
@@ -288,9 +424,14 @@ def cv(
                     end_iteration=num_boost_round, evaluation_result_list=None,
                 ))
         fold_results = []
-        for bst in cvfolds.boosters:
-            bst.update(fobj=fobj)
-            fold_results.append(bst.eval_valid(feval))
+        if batch_folds:
+            train_forest_round([b._gbdt for b in cvfolds.boosters])
+            for bst in cvfolds.boosters:
+                fold_results.append(bst.eval_valid(feval))
+        else:
+            for bst in cvfolds.boosters:
+                bst.update(fobj=fobj)
+                fold_results.append(bst.eval_valid(feval))
         res = _agg_cv_result(fold_results)
         for _, key, mean, _, std in res:
             results[key + "-mean"].append(mean)

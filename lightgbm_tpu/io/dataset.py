@@ -237,6 +237,20 @@ class BinnedDataset:
         dropped, so this is the TPU-transfer layout, not a memory bomb)."""
         return self.X_bin.toarray() if self.is_sparse else self.X_bin
 
+    def dense_bins_T_device(self):
+        """The feature-major [F, n] binned matrix ON DEVICE, cached on
+        the dataset so every booster sharing this dataset — cv() folds,
+        train_many() models — shares ONE device copy instead of
+        uploading num_models duplicates (the forest-batching HBM
+        contract, docs/forest_batching.md)."""
+        cached = getattr(self, "_bins_T_device", None)
+        if cached is None:
+            import jax.numpy as jnp
+
+            cached = jnp.asarray(np.ascontiguousarray(self.dense_bins().T))
+            self._bins_T_device = cached
+        return cached
+
     @property
     def num_data(self) -> int:
         return self.X_bin.shape[0]

@@ -273,6 +273,7 @@ def default_search_fn(
         "num_bins", "max_leaves", "hist_fn", "reduce_fn", "search_fn",
         "reduce_max_fn", "child_counts_fn", "search2_fn", "hist_pool",
         "init_hist_fn", "init_search_fn", "hist_fn_raw", "record_mode",
+        "choice_by_mask_counts",
     ),
 )
 def grow_tree(
@@ -299,6 +300,7 @@ def grow_tree(
     init_search_fn=None,
     hist_fn_raw=None,
     record_mode: bool = False,
+    choice_by_mask_counts: bool = False,
 ) -> Tuple[Tree, jax.Array]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -379,6 +381,14 @@ def grow_tree(
     # one launch) — unpooled only: the left child reuses the parent's
     # buffer row
     opt_fused = opt and not (0 < hist_pool < max_leaves)
+    if choice_by_mask_counts and opt:
+        # the raw-layout fused kernels pick the small child positionally
+        # INSIDE the launch; callers that set a base row mask (cv
+        # bin-once) are gated to the canonical path before reaching here
+        raise NotImplementedError(
+            "choice_by_mask_counts requires the canonical (non-raw-"
+            "kernel) grow path"
+        )
     # ``record_mode``: PARALLEL learners (search hooks present) opt into
     # the leaf-sorted packed-record partition — the round-3/4 fast path
     # was previously serial-only, leaving every distributed run on the
@@ -541,8 +551,22 @@ def grow_tree(
     if init_tree is None:
         # ---- root (BeforeTrain / LeafSplits::Init, leaf_splits.hpp:51-92)
         hist0 = hist_fn(bins_T, grad, hess, bag_mask)
-        sum_g0 = jnp.sum(grad * bag_mask)
-        sum_h0 = jnp.sum(hess * bag_mask)
+        # root Σg/Σh via a ONE-segment segment-sum, not jnp.sum: scatter
+        # accumulates per row in order, so a masked-out row adds an exact
+        # ±0.0 that never perturbs the accumulator.  jnp.sum's reduction
+        # tree regroups with n, making the root sums depend on how many
+        # DEAD rows ride along — which would break the base-row-mask
+        # parity contract (cv bin-once trains fold boosters on the full
+        # matrix and pins their metrics bitwise to subset-trained ones)
+        # and the batched forest grower's stacked-vs-loop parity pin.
+        # cnt0 stays jnp.sum: counts are exact small integers in any
+        # grouping.
+        gh0 = jax.ops.segment_sum(
+            jnp.stack([grad * bag_mask, hess * bag_mask], axis=-1),
+            jnp.zeros(grad.shape[0], jnp.int32),
+            num_segments=1,
+        )[0]
+        sum_g0, sum_h0 = gh0[0], gh0[1]
         cnt0 = jnp.sum(bag_mask)
         if reduce_fn is not None:
             # one stacked collective for the tree-start allreduce
@@ -804,7 +828,21 @@ def grow_tree(
         nleft_g, nright_g, nleft_gate, nright_gate = child_counts_fn(
             nleft, nright
         )
-        small_is_left = nleft_g <= nright_g
+        if choice_by_mask_counts:
+            # base-row-mask mode (cv bin-once, gbdt.set_base_row_mask):
+            # pick the small child by the split's MASKED counts instead.
+            # A fold booster trained on the full matrix with the fold
+            # mask sees positional counts inflated by held-out rows,
+            # which could flip this choice vs. the subset-trained run —
+            # and the direct-vs-subtracted child histograms differ in
+            # final ulps.  lc/rc are the mask-weighted counts from the
+            # split search, exactly the subset run's positional counts
+            # (its mask is all-ones), so the choice — hence every
+            # histogram — matches the subset run bitwise.  Window sizes
+            # below stay positional: held-out rows still occupy slots.
+            small_is_left = lc <= rc
+        else:
+            small_is_left = nleft_g <= nright_g
         cnt_s = jnp.where(small_is_left, nleft, nright)
         cnt_s_gate = jnp.where(small_is_left, nleft_gate, nright_gate)
         begin_s = jnp.where(small_is_left, begin, begin + nleft)

@@ -59,6 +59,15 @@ _PREDICT_MM = os.environ.get("LGBM_TPU_PREDICT_MATMUL", "auto")
 # intermediates (~2.5KB/row/tree-step at L=255) well inside HBM
 _ROW_CHUNK = int(os.environ.get("LGBM_TPU_PREDICT_ROW_CHUNK", str(1 << 20)))
 
+# forest_batching="auto" row ceiling: the explicit batched grow loop
+# (learners/forest.py) does O(n) work per split per lane while the
+# sequential windows tier down, so its win inverts as n grows — the
+# CPU-container sweep (docs/forest_batching.md) crosses between 2k rows
+# (1.45x faster) and 4k (0.64x).  Chip re-evaluation rides
+# forest_batching="on" or this env knob.
+_FOREST_AUTO_MAX_ROWS = int(os.environ.get("LGBM_TPU_FOREST_MAX_ROWS",
+                                           "2048"))
+
 
 def _use_matmul_predict() -> bool:
     if _PREDICT_MM == "auto":
@@ -165,7 +174,9 @@ class GBDT:
         if self.objective is not None and self.objective.name == "binary":
             self.sigmoid = self.objective.sigmoid
 
-        self._bins_T = jnp.asarray(np.ascontiguousarray(train_set.dense_bins().T))
+        # device copy cached ON the dataset: cv folds / train_many models
+        # constructed over the same BinnedDataset share one upload
+        self._bins_T = train_set.dense_bins_T_device()
         self._num_bins = max(int(train_set.max_num_bin), 2)
         self._nbpf = jnp.asarray(train_set.num_bins_per_feature)
         self._is_cat = jnp.asarray(train_set.is_categorical)
@@ -475,6 +486,29 @@ class GBDT:
             self._valid_scores[-1] = acc
 
     # ---------------------------------------------------------------- bagging
+    def set_base_row_mask(self, mask) -> None:
+        """Persistent row mask ANDed under any bagging draw — how cv()
+        trains each fold on the SHARED full binned matrix: the fold's
+        held-out rows never enter histograms/counts, so the grown trees
+        are bitwise the subset-trained ones (same nonzero contributions
+        in the same row order; engine.cv, docs/forest_batching.md).
+
+        Requires the canonical serial leaf-wise grower: the child-choice
+        criterion switches to masked counts (choice_by_mask_counts in
+        learners/serial.py explains why positional counts would break
+        the subset-parity contract)."""
+        if getattr(self._grow, "func", None) is not grow_tree:
+            raise ValueError(
+                "set_base_row_mask requires the serial leaf-wise tree "
+                "learner (canonical path)"
+            )
+        m = jnp.asarray(mask, jnp.float32)
+        self._base_row_mask = m
+        self._bag_mask = self._bag_mask * m
+        self._bag_cnt = int(jnp.sum(self._bag_mask))
+        self._grow = functools.partial(
+            self._grow, choice_by_mask_counts=True)
+
     def _update_bagging(self) -> None:
         """GBDT::Bagging (gbdt.cpp:157-208): every bagging_freq iterations
         draw floor(n * bagging_fraction) rows (query-granular for ranking)."""
@@ -498,6 +532,9 @@ class GBDT:
             idx = self._bag_rng.choice(n, size=take, replace=False)
             mask = np.zeros(n, np.float32)
             mask[idx] = 1.0
+        base = getattr(self, "_base_row_mask", None)
+        if base is not None:
+            mask = mask * np.asarray(base)
         self._bag_mask = jnp.asarray(mask)
         self._bag_cnt = int(mask.sum())
 
@@ -572,11 +609,67 @@ class GBDT:
         except Exception:
             return None
 
-    def _train_one_iter_impl(
-        self,
-        grad: Optional[np.ndarray] = None,
-        hess: Optional[np.ndarray] = None,
-    ) -> bool:
+    # -------------------------------------------- forest-batched dispatch
+    def _forest_eligible(self) -> bool:
+        """May this booster's trees grow through the batched forest path
+        (learners/forest.py)?  Mirrors the canonical serial branch of
+        _create_tree_learner: single-process leaf-wise growth with the
+        segment-sum histograms and jnp search — the op set the explicit
+        batched loop reproduces bitwise.  Kernel paths (Pallas hist /
+        raw-layout opt mode), f64 accumulation, pooled histograms, and
+        parallel learners fall back to the sequential grower; whether
+        vmap pessimizes those kernels is a tools/kernel_ab.py question
+        for the next chip window (docs/forest_batching.md)."""
+        cfg = self.config
+        knob = getattr(cfg, "forest_batching", "auto")
+        if knob == "off":
+            return False
+        if not (cfg.tree_learner == "serial" or len(jax.devices()) == 1):
+            return False
+        if jax.process_count() > 1 or cfg.tree_growth != "leafwise":
+            return False
+        if self._use_f64_hist or self._hist_pool_slots():
+            return False
+        if (self._leafwise_hist_fn() is not None
+                or self._leafwise_hist_fn_raw() is not None):
+            return False
+        if knob == "on":
+            return True
+        # auto: the batched loop's per-split work is O(n) per lane while
+        # the sequential windows tier down — measured CPU crossover sits
+        # between 2k rows (1.45x) and 4k rows (0.64x); docs carry the
+        # sweep.  forest_batching="on" overrides for chip re-evaluation.
+        return self.num_data <= _FOREST_AUTO_MAX_ROWS
+
+    def _grow_forest_batched(self, grads, hesses, bag_masks, fmasks,
+                             params_lanes):
+        """One batched dispatch growing ``B = len(fmasks)`` trees.
+        Operands are [B, ...] stacks (grad/hess/bag per lane, feature
+        mask per lane, TreeLearnerParams with [B] fields).  Returns the
+        batched Tree pytree + leaf_id[B, n]."""
+        from ..learners import forest
+
+        gf = forest.make_grow_forest(
+            self._num_bins, self.max_leaves,
+            choice_by_mask_counts=(
+                getattr(self, "_base_row_mask", None) is not None),
+        )
+        trees, leaf_ids = gf(
+            self._bins_T, grads, hesses, bag_masks, fmasks,
+            self._nbpf, self._is_cat, params_lanes,
+        )
+        telemetry.count("forest_dispatches")
+        telemetry.count("forest_batched_trees", int(leaf_ids.shape[0]))
+        return trees, leaf_ids
+
+    def _forest_begin_iter(self, grad=None, hess=None):
+        """First half of a boosting iteration, up to (not including) the
+        tree growth: lagged-stop drain, objective gradients, non-finite
+        guard, bagging, per-class feature samples.  Returns "stop",
+        "skip", or (grad[K, n], hess[K, n], fmasks, nf_snap).  Factored
+        out of _train_one_iter_impl so train_forest_round can stack the
+        grow work of MANY boosters into one dispatch between identical
+        begin/finish halves."""
         K = self.num_class
         # lagged stop check, consume side: BEFORE growing anything this
         # iteration, materialize parked num_leaves values that are now
@@ -595,7 +688,7 @@ class GBDT:
                 for _ in range(len(self._pending_stop)):
                     self.rollback_one_iter()
                 self._pending_stop.clear()
-                return True
+                return "stop"
         if grad is None or hess is None:
             scores = self._scores if K > 1 else self._scores[0]
             grad, hess = self.objective.get_gradients(scores)
@@ -619,12 +712,107 @@ class GBDT:
                 nf_snap = self.snapshot_state()
             grad, hess, skip_iter = self._nf_guard.check_gradients(grad, hess)
             if skip_iter:
-                return False
+                return "skip"
 
         self._update_bagging()
+        # per-class feature samples drawn in k-order BEFORE any growth:
+        # same _feat_rng consumption sequence as the sequential k-loop
+        # (nothing else draws between them), so stacked == loop trees
+        fmasks = [self._sample_features() for _ in range(K)]
+        return grad, hess, fmasks, nf_snap
+
+    def _forest_finish_tree(self, k: int, tree, leaf_id) -> bool:
+        """Second half, per grown tree: lagged-stop bookkeeping,
+        non-finite leaf guard, shrinkage + score/threshold dispatch,
+        valid-score updates, model append.  Returns could_split."""
+        K = self.num_class
+        if self._stop_lag <= 0 or K != 1:
+            could_split = int(tree.num_leaves) > 1
+        else:
+            # lagged stop check (LGBM_TPU_STOP_LAG): int(num_leaves)
+            # every iteration blocks the host on the WHOLE tree
+            # computation, draining the dispatch pipeline and
+            # exposing the axon-tunnel RTT (~0.3 s/tree measured at
+            # 1M rows).  Park the device scalar and start its host
+            # copy; the NEXT call materializes values that are
+            # ``lag`` iterations old (see _forest_begin_iter) and
+            # rolls back to the exact eager-mode state on terminal
+            # detection.
+            nl = tree.num_leaves
+            try:
+                nl.copy_to_host_async()
+            except Exception:
+                pass
+            self._pending_stop.append(nl)
+            could_split = True
+        if self._nf_guard is not None:
+            # leaf-output guard (clip/count); never drops a tree —
+            # the models list must stay iter-major K-aligned
+            tree, _ = self._nf_guard.check_tree(tree)
+        # shrink + score apply + threshold finalization as ONE
+        # dispatch (each eager jnp op is its own round trip over the
+        # axon tunnel; the host-side finalize_thresholds even forced
+        # a full device sync per tree)
+        tree, self._scores = _post_grow_step(
+            tree, self._scores, jnp.int32(k),
+            leaf_id, jnp.float32(self.learning_rate),
+            self._bounds_mat, self._real_feat_dev,
+        )
+        for vi in range(len(self.valid_sets)):
+            self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
+                predict_binned(tree, self._valid_bins[vi])
+            )
+        self.models.append(tree)
+        return could_split
+
+    def _forest_finish_iter(self, grown, nf_snap) -> bool:
+        """Close an iteration whose K trees were grown elsewhere (the
+        batched dispatch).  ``grown`` is [(tree, leaf_id)] in class
+        order.  Returns True when training should stop."""
+        could_split_any = False
+        for k, (tree, leaf_id) in enumerate(grown):
+            if self._forest_finish_tree(k, tree, leaf_id):
+                could_split_any = True
+        self.iter_ += 1
+        self._model_version += 1
+        if self._nf_guard is not None:
+            self._nf_guard.raise_if_poisoned(self, nf_snap)
+        return not could_split_any
+
+    def _train_one_iter_impl(
+        self,
+        grad: Optional[np.ndarray] = None,
+        hess: Optional[np.ndarray] = None,
+    ) -> bool:
+        K = self.num_class
+        pre = self._forest_begin_iter(grad, hess)
+        if pre == "stop":
+            return True
+        if pre == "skip":
+            return False
+        grad, hess, fmasks, nf_snap = pre
+
+        if K > 1 and self._forest_eligible():
+            # multiclass: the K per-class trees of ONE iteration share
+            # grad/hess batches and the bagging mask already — grow all
+            # K in one batched dispatch (ROADMAP item 2), bitwise the
+            # sequential k-loop's trees (tier-1 pins this)
+            from ..learners import forest
+
+            params_lanes = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (K,)), self._learner_params)
+            trees_b, lids = self._grow_forest_batched(
+                grad, hess,
+                jnp.broadcast_to(self._bag_mask, (K, self.num_data)),
+                jnp.stack(fmasks), params_lanes,
+            )
+            grown = [(forest.unstack_tree(trees_b, k), lids[k])
+                     for k in range(K)]
+            return self._forest_finish_iter(grown, nf_snap)
+
         could_split_any = False
         for k in range(K):
-            fmask = self._sample_features()
+            fmask = fmasks[k]
             if self._use_f64_hist:
                 with enable_x64(True):
                     gk = grad[k].astype(jnp.float64)
@@ -649,44 +837,8 @@ class GBDT:
                     self._is_cat,
                     self._learner_params,
                 )
-            if self._stop_lag <= 0 or K != 1:
-                if int(tree.num_leaves) > 1:
-                    could_split_any = True
-            else:
-                # lagged stop check (LGBM_TPU_STOP_LAG): int(num_leaves)
-                # every iteration blocks the host on the WHOLE tree
-                # computation, draining the dispatch pipeline and
-                # exposing the axon-tunnel RTT (~0.3 s/tree measured at
-                # 1M rows).  Park the device scalar and start its host
-                # copy; the NEXT call materializes values that are
-                # ``lag`` iterations old (see the check at the top of
-                # this method) and rolls back to the exact eager-mode
-                # state on terminal detection.
-                nl = tree.num_leaves
-                try:
-                    nl.copy_to_host_async()
-                except Exception:
-                    pass
-                self._pending_stop.append(nl)
+            if self._forest_finish_tree(k, tree, leaf_id):
                 could_split_any = True
-            if self._nf_guard is not None:
-                # leaf-output guard (clip/count); never drops a tree —
-                # the models list must stay iter-major K-aligned
-                tree, _ = self._nf_guard.check_tree(tree)
-            # shrink + score apply + threshold finalization as ONE
-            # dispatch (each eager jnp op is its own round trip over the
-            # axon tunnel; the host-side finalize_thresholds even forced
-            # a full device sync per tree)
-            tree, self._scores = _post_grow_step(
-                tree, self._scores, jnp.int32(k),
-                leaf_id, jnp.float32(self.learning_rate),
-                self._bounds_mat, self._real_feat_dev,
-            )
-            for vi in range(len(self.valid_sets)):
-                self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
-                    predict_binned(tree, self._valid_bins[vi])
-                )
-            self.models.append(tree)
         self.iter_ += 1
         self._model_version += 1
         if self._nf_guard is not None:
@@ -1203,6 +1355,109 @@ class GBDT:
     @property
     def current_iteration(self) -> int:
         return len(self.models) // max(self.num_class, 1)
+
+
+def train_forest_round(gbdts: List["GBDT"]) -> List[bool]:
+    """Advance every booster in ``gbdts`` one boosting iteration,
+    growing ALL their trees (sum of num_class lanes) in ONE batched
+    dispatch (learners/forest.py).  This is the cross-model B-source:
+    engine.train_many's N independent small models and engine.cv's
+    folds share a binned dataset, so their per-iteration grow work is
+    shape-identical and stacks along the lane axis.
+
+    Requirements (raise ValueError otherwise — the callers validate
+    configs upfront and fall back to per-booster sequential training):
+    every booster _forest_eligible() under its own knob, same binned
+    matrix object (dense_bins_T_device cache), same num_bins and
+    max_leaves.  Per-lane TreeLearnerParams may differ (lambda_l1/l2,
+    min_data_in_leaf, ... ride the stacked params lanes).
+
+    Returns a per-booster "should stop" flag, aligned with ``gbdts``.
+    Boosters whose begin-half says "stop"/"skip" simply contribute no
+    lanes; a shrinking active set retraces once per distinct lane
+    count (cached in make_grow_forest's lru table).
+    """
+    from ..learners import forest
+
+    if not gbdts:
+        return []
+    ref = gbdts[0]
+    for b in gbdts:
+        if not b._forest_eligible():
+            raise ValueError(
+                "train_forest_round: booster not forest-eligible "
+                "(forest_batching=off, kernel/f64/pooled-histogram path, "
+                "or parallel learner)"
+            )
+        if b._bins_T is not ref._bins_T:
+            raise ValueError(
+                "train_forest_round: boosters must share one binned "
+                "dataset (same Dataset object, bin once)"
+            )
+        if (b._num_bins != ref._num_bins
+                or b.max_leaves != ref.max_leaves):
+            raise ValueError(
+                "train_forest_round: max_bin and num_leaves must match "
+                "across boosters (they fix the traced program shape)"
+            )
+        if ((getattr(b, "_base_row_mask", None) is None)
+                != (getattr(ref, "_base_row_mask", None) is None)):
+            raise ValueError(
+                "train_forest_round: base row masks (cv fold mode) must "
+                "be set on all boosters or none (the child-choice "
+                "criterion is static per traced program)"
+            )
+
+    stops: List[bool] = [False] * len(gbdts)
+    active: List[int] = []  # indices into gbdts with grow work
+    pres = []
+    for i, b in enumerate(gbdts):
+        pre = b._forest_begin_iter()
+        if pre == "stop":
+            stops[i] = True
+        elif pre == "skip":
+            stops[i] = False
+        else:
+            active.append(i)
+            pres.append(pre)
+    if not active:
+        return stops
+
+    grads, hesses, bags, fmasks, plist = [], [], [], [], []
+    lane_of = []  # (booster index, class k) per lane
+    for i, (grad, hess, fms, _snap) in zip(active, pres):
+        b = gbdts[i]
+        for k in range(b.num_class):
+            grads.append(grad[k])
+            hesses.append(hess[k])
+            bags.append(b._bag_mask)
+            fmasks.append(fms[k])
+            plist.append(b._learner_params)
+            lane_of.append((i, k))
+
+    gf = forest.make_grow_forest(
+        ref._num_bins, ref.max_leaves,
+        choice_by_mask_counts=(
+            getattr(ref, "_base_row_mask", None) is not None),
+    )
+    trees_b, lids = gf(
+        ref._bins_T, jnp.stack(grads), jnp.stack(hesses),
+        jnp.stack(bags), jnp.stack(fmasks), ref._nbpf, ref._is_cat,
+        forest.stack_learner_params(plist),
+    )
+    telemetry.count("forest_dispatches")
+    telemetry.count("forest_batched_trees", len(lane_of))
+
+    # distribute lanes back booster-major (lane_of is already grouped)
+    per_booster: Dict[int, list] = {}
+    for lane, (i, _k) in enumerate(lane_of):
+        per_booster.setdefault(i, []).append(
+            (forest.unstack_tree(trees_b, lane), lids[lane])
+        )
+    for pos, i in enumerate(active):
+        nf_snap = pres[pos][3]
+        stops[i] = gbdts[i]._forest_finish_iter(per_booster[i], nf_snap)
+    return stops
 
 
 def _fmt(x) -> str:

@@ -62,20 +62,30 @@ def _rec_height(features: int, bin_bytes: int) -> int:
 def predict(rows: int, features: int, bins: int = 255, leaves: int = 31,
             num_class: int = 1, world: int = 1, routing: str = "prefix",
             hist_prec: str = "float32",
-            bucket_rows: Iterable[int] = ()) -> dict:
+            bucket_rows: Iterable[int] = (),
+            forest_batch: int = 1) -> dict:
     """Expected per-chip live set, per phase, in bytes.
 
     ``routing`` is one of ``order`` (serial scatter learner),
     ``prefix`` / ``onehot`` (record-mode partition kernels).
     ``bucket_rows`` lists the serving shape-bucket capacities when the
     chip also serves.  All sizes are per data-parallel shard
-    (``rows / world``)."""
+    (``rows / world``).
+
+    ``forest_batch`` is the number of INDEPENDENT models/folds trained
+    through the batched forest dispatch (learners/forest.py) on the ONE
+    shared binned matrix: per-model buffers (scores, bag masks,
+    grad/hess) scale by B, and the dispatch-scoped buffers (histograms,
+    routing) scale by all ``B * num_class`` lanes.  B=1 keeps the model
+    describing the sequential grower exactly — the shape the tier-1
+    model-vs-census pin measures."""
     rows = int(rows)
     features = int(features)
     bins = int(bins)
     leaves = int(leaves)
     num_class = max(1, int(num_class))
     world = max(1, int(world))
+    forest_batch = max(1, int(forest_batch))
     n = -(-rows // world)
 
     bin_bytes = 1 if bins <= 256 else 2
@@ -84,12 +94,19 @@ def predict(rows: int, features: int, bins: int = 255, leaves: int = 31,
     grad_bytes = hist_bytes  # float64 hists upcast the grad/hess pair
 
     dataset = features * n * bin_bytes
-    scores = num_class * n * 4
-    bag_mask = n * 4
-    grad_hess = 2 * num_class * n * grad_bytes
+    scores = forest_batch * num_class * n * 4
+    bag_mask = forest_batch * n * 4
+    grad_hess = forest_batch * 2 * num_class * n * grad_bytes
     hists = leaves * features * bins * 3 * hist_bytes
 
-    if routing == "order":
+    if forest_batch > 1:
+        # batched forest dispatch: one histogram tier and one direct
+        # row->leaf map per LANE (learners/forest.py _ForestState);
+        # the record/order permutation machinery does not exist there
+        lanes = forest_batch * num_class
+        hists *= lanes
+        routing_scratch = lanes * n * 4
+    elif routing == "order":
         routing_scratch = n * 4
     else:
         rec = _rec_height(features, bin_bytes) * _round_up(
@@ -131,6 +148,7 @@ def predict(rows: int, features: int, bins: int = 255, leaves: int = 31,
             "leaves": leaves, "num_class": num_class, "world": world,
             "routing": routing, "hist_prec": str(hist_prec),
             "bucket_rows": buckets, "rows_per_shard": n,
+            "forest_batch": forest_batch,
         },
         "components": components,
         "resident_bytes": int(resident),
@@ -177,6 +195,29 @@ def max_rows(capacity_bytes: int, **params: Any) -> int:
     while lo + 1 < hi:
         mid = (lo + hi) // 2
         if predict(rows=mid, **params)["peak_bytes"] <= capacity:
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def max_forest_batch(capacity_bytes: int, **params: Any) -> int:
+    """Largest forest-batch lane count B whose predicted peak fits
+    ``capacity_bytes`` at the given shape — the sizing input for
+    picking B on chip (tools/hbm_budget.py --forest-batch).  ``params``
+    are the non-``forest_batch`` arguments of :func:`predict` (``rows``
+    included).  0 when even B=1 does not fit."""
+    capacity = int(capacity_bytes)
+    if predict(forest_batch=1, **params)["peak_bytes"] > capacity:
+        return 0
+    lo, hi = 1, 2
+    while predict(forest_batch=hi, **params)["peak_bytes"] <= capacity:
+        lo, hi = hi, hi * 2
+        if hi > 1 << 30:
+            return lo
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        if predict(forest_batch=mid, **params)["peak_bytes"] <= capacity:
             lo = mid
         else:
             hi = mid

@@ -1,0 +1,405 @@
+"""Forest-level batched dispatch (learners/forest.py + models/gbdt.py
+train_forest_round + engine.train_many / cv fold batching).
+
+The contract under test, in order of importance:
+
+1. BITWISE parity — a tree grown as lane ``i`` of a batched forest
+   dispatch is byte-identical (``tobytes`` over every tree array) to
+   the same tree grown alone through the sequential grower, across
+   every B-source: multiclass per-class trees, bagged lanes, cv folds,
+   and heterogeneous ``train_many`` sweeps.  Not a tolerance.
+2. ONE program — ``grow_traces`` / backend compiles per batched sweep
+   do not scale with B: one trace advances the whole forest.
+3. cv bin-once — fold metrics through the shared-matrix base-row-mask
+   path are identical to the old per-fold subset path.
+4. Planning + gating — the memmodel B axis (B=1 exactly the sequential
+   model), benchdiff's forest-bench kind (mismatched kinds exit 2,
+   speedup/parity regressions flagged), and the committed
+   .bench/forest_sweep.json acceptance row.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.telemetry import get_telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _data(n=200, f=10, classes=2, seed=3):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f).astype(np.float32)
+    w = r.randn(f)
+    z = X @ w + 0.5 * r.randn(n)
+    if classes == 2:
+        y = (z > 0).astype(np.float32)
+    else:
+        y = np.digitize(
+            z, np.quantile(z, np.linspace(0, 1, classes + 1)[1:-1])
+        ).astype(np.float32)
+    return X, y
+
+
+def _params(classes=2, **kw):
+    p = {"num_leaves": 7, "max_bin": 31, "min_data_in_leaf": 3,
+         "learning_rate": 0.1, "verbose": -1, "seed": 11}
+    if classes == 2:
+        p["objective"] = "binary"
+    else:
+        p.update(objective="multiclass", num_class=classes)
+    p.update(kw)
+    return p
+
+
+# --------------------------------------------------------------- parity
+
+def test_grow_level_tobytes_parity_stacked_vs_loop():
+    """The literal acceptance criterion: every array of a batched-lane
+    tree is tobytes-equal to its sequentially grown twin — bagged
+    masks, per-lane feature masks, a categorical column, heterogeneous
+    learner params."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learners.forest import (
+        make_grow_forest, stack_learner_params, unstack_tree)
+    from lightgbm_tpu.learners.serial import TreeLearnerParams, grow_tree
+
+    n, F, nb, L, B = 96, 6, 15, 7, 3
+    r = np.random.RandomState(5)
+    bins = jnp.asarray(r.randint(0, nb, size=(F, n)).astype(np.uint8))
+    grads = jnp.asarray(r.randn(B, n).astype(np.float32))
+    hesses = jnp.asarray(
+        (np.abs(r.randn(B, n)) + 0.1).astype(np.float32))
+    bags = jnp.asarray((r.rand(B, n) < 0.8).astype(np.float32))
+    fmask = jnp.asarray(np.ones((B, F), bool))
+    nbpf = jnp.asarray(np.full(F, nb, np.int32))
+    is_cat = jnp.asarray(np.eye(1, F, 2, dtype=bool)[0])
+    plist = [TreeLearnerParams(
+        min_data_in_leaf=jnp.float32(3 + i),
+        min_sum_hessian_in_leaf=jnp.float32(1e-3),
+        lambda_l1=jnp.float32(0.2 * i),
+        lambda_l2=jnp.float32(0.1 * (i + 1)),
+        min_gain_to_split=jnp.float32(0.0),
+        max_depth=jnp.int32(0 if i == 0 else 5),
+    ) for i in range(B)]
+
+    gf = make_grow_forest(nb + 1, L, "batched")
+    trees_b, lid_b = gf(bins, grads, hesses, bags, fmask, nbpf, is_cat,
+                        stack_learner_params(plist))
+    jax.block_until_ready(lid_b)
+    for i in range(B):
+        t_s, lid_s = grow_tree(
+            bins, grads[i], hesses[i], bags[i], fmask[i], nbpf, is_cat,
+            plist[i], num_bins=nb + 1, max_leaves=L)
+        t_b = unstack_tree(trees_b, i)
+        for name in t_s._fields:
+            a, b = np.asarray(getattr(t_s, name)), np.asarray(
+                getattr(t_b, name))
+            assert a.tobytes() == b.tobytes(), (i, name)
+        assert np.asarray(lid_s).tobytes() == np.asarray(
+            lid_b[i]).tobytes(), i
+
+
+@pytest.mark.parametrize("bagging", [False, True])
+def test_multiclass_engine_parity_on_vs_off(bagging):
+    """Multiclass per-class trees through the forced batched dispatch
+    produce the same model file, byte for byte, as the sequential
+    per-class loop — with and without bagged lanes."""
+    X, y = _data(n=180, classes=3)
+    extra = ({"bagging_fraction": 0.7, "bagging_freq": 1}
+             if bagging else {})
+    models = {}
+    for knob in ("on", "off"):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(
+            _params(classes=3, forest_batching=knob, **extra), ds,
+            num_boost_round=4, verbose_eval=False)
+        models[knob] = bst.model_to_string()
+    assert models["on"] == models["off"]
+
+
+def test_train_many_parity_with_sequential_train():
+    """Heterogeneous N-model sweeps: each train_many booster equals the
+    model trained alone through engine.train."""
+    X, y = _data(n=150)
+    ds = lgb.Dataset(X, label=y)
+    plist = [_params(forest_batching="on", learning_rate=0.05 + 0.02 * i,
+                     lambda_l2=0.1 * (i + 1), seed=20 + i)
+             for i in range(4)]
+    batched = lgb.train_many(plist, ds, num_boost_round=3)
+    for i, p in enumerate(plist):
+        solo = lgb.train(
+            {**p, "forest_batching": "off"},
+            lgb.Dataset(X, label=y), num_boost_round=3,
+            verbose_eval=False)
+        assert batched[i].model_to_string() == solo.model_to_string(), i
+
+
+def test_train_many_rejects_mismatched_program_shape():
+    X, y = _data(n=120)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(ValueError, match="num_leaves"):
+        lgb.train_many([_params(), _params(num_leaves=15)], ds,
+                       num_boost_round=2)
+
+
+# ------------------------------------------------ one-program trace pin
+
+def test_grow_traces_and_compiles_do_not_scale_with_B():
+    """Satellite 1: a batched sweep of B models costs ONE grower trace
+    (and O(1) backend compiles), whatever B is — the dispatch-floor
+    amortization the tentpole exists for."""
+    X, y = _data(n=130, f=9, seed=9)
+    tel = get_telemetry()
+    # warm the non-grow plumbing (binning, predict, metric programs) so
+    # the measured compile deltas isolate the lane-stacked programs
+    lgb.train_many(
+        [_params(forest_batching="on", num_leaves=5, max_bin=21, seed=39)
+         for _ in range(2)],
+        lgb.Dataset(X, label=y), num_boost_round=3)
+    per_b = {}
+    for b_width in (3, 6):
+        ds = lgb.Dataset(X, label=y)
+        plist = [_params(forest_batching="on", num_leaves=5, max_bin=21,
+                         seed=40 + i, learning_rate=0.05 + 0.01 * i)
+                 for i in range(b_width)]
+        tel.reset()
+        before = int(tel.snapshot()["counters"].get(
+            "backend_compiles", 0))
+        lgb.train_many(plist, ds, num_boost_round=3)
+        snap = tel.snapshot()["counters"]
+        per_b[b_width] = {
+            "traces": int(snap.get("grow_traces", 0)),
+            "compiles": int(snap.get("backend_compiles", 0)) - before,
+            "dispatches": int(snap.get("forest_dispatches", 0)),
+            "trees": int(snap.get("forest_batched_trees", 0)),
+        }
+    for b_width, got in per_b.items():
+        assert got["traces"] == 1, (b_width, got)
+        assert got["dispatches"] == 3, (b_width, got)
+        assert got["trees"] == 3 * b_width, (b_width, got)
+    # a new lane width recompiles the stacked programs once plus a few
+    # eager per-shape stubs for the [B]-dim host arrays — near-constant
+    # in B.  A trace-per-model dispatch would at least double the
+    # count when B doubles; pin that it does not.
+    assert per_b[6]["compiles"] < 2 * per_b[3]["compiles"], per_b
+
+
+# ------------------------------------------------------ cv fold batching
+
+def test_cv_bin_once_metrics_match_subset_path(monkeypatch):
+    """Satellite 2: cv() through the shared-matrix base-row-mask path
+    (one binned copy, batched fold dispatch) returns metrics IDENTICAL
+    to the old per-fold Dataset.subset path — toggled here by forcing
+    the share gate off."""
+    import lightgbm_tpu.engine as engine
+
+    X, y = _data(n=160, f=8, seed=13)
+    params = _params(forest_batching="on")
+    kw = dict(num_boost_round=4, nfold=3, seed=7, shuffle=True,
+              stratified=False)
+    res_new = lgb.cv(params, lgb.Dataset(X, label=y), **kw)
+
+    monkeypatch.setattr(engine, "_cv_can_share_bins",
+                        lambda *a, **k: False)
+    res_old = lgb.cv(params, lgb.Dataset(X, label=y), **kw)
+
+    assert sorted(res_new) == sorted(res_old)
+    for key in res_new:
+        a = np.asarray(res_new[key], np.float64)
+        b = np.asarray(res_old[key], np.float64)
+        assert a.tobytes() == b.tobytes(), key
+
+
+def test_cv_bin_once_shares_the_binned_matrix():
+    """The savings claim: fold boosters on the share path hold the SAME
+    device binned matrix (identity, not equality), with fold membership
+    expressed as a base row mask."""
+    import lightgbm_tpu.engine as engine
+    from lightgbm_tpu.engine import _make_n_folds
+
+    X, y = _data(n=120, f=6)
+    full = lgb.Dataset(X, label=y)
+    inner = full.construct()
+    assert engine._cv_can_share_bins(
+        dict(_params()), inner, None, None)
+    folds = _make_n_folds(full, 3, dict(_params()), 2, False, True)
+    ref_bins = None
+    for train_idx, _test_idx in folds:
+        bst = lgb.Booster(params=_params(), train_set=full)
+        mask = np.zeros(full.num_data(), np.float32)
+        mask[np.sort(train_idx)] = 1.0
+        bst._gbdt.set_base_row_mask(mask)
+        if ref_bins is None:
+            ref_bins = bst._gbdt._bins_T
+        assert bst._gbdt._bins_T is ref_bins
+
+
+def test_cv_share_gates_fall_back():
+    """Configs whose stats consult the unmasked row universe (bagging
+    draw domain etc.) must NOT take the share path."""
+    import lightgbm_tpu.engine as engine
+
+    X, y = _data(n=100, f=5)
+    inner = lgb.Dataset(X, label=y).construct()
+    ok = dict(_params())
+    assert engine._cv_can_share_bins(ok, inner, None, None)
+    assert not engine._cv_can_share_bins(
+        {**ok, "bagging_fraction": 0.7, "bagging_freq": 1},
+        inner, None, None)
+    assert not engine._cv_can_share_bins(ok, inner, None, lambda *a: None)
+    assert not engine._cv_can_share_bins(
+        ok, inner, lambda tr, te, p: (tr, te, p), None)
+
+
+# ----------------------------------------------------------- eligibility
+
+def test_forest_auto_gate_and_knobs():
+    X, y = _data(n=140)
+    for knob, expect in (("on", True), ("off", False), ("auto", True)):
+        bst = lgb.Booster(params=_params(forest_batching=knob),
+                          train_set=lgb.Dataset(X, label=y))
+        assert bst._gbdt._forest_eligible() is expect, knob
+    # auto backs off past the measured CPU crossover; "on" still forces
+    big_n = int(os.environ.get("LGBM_TPU_FOREST_MAX_ROWS", 2048)) + 8
+    Xb, yb = _data(n=big_n, f=4)
+    auto = lgb.Booster(params=_params(), train_set=lgb.Dataset(Xb, label=yb))
+    assert not auto._gbdt._forest_eligible()
+    forced = lgb.Booster(params=_params(forest_batching="on"),
+                         train_set=lgb.Dataset(Xb, label=yb))
+    assert forced._gbdt._forest_eligible()
+
+
+def test_forest_batching_knob_validated():
+    with pytest.raises(Exception):
+        lgb.train(_params(forest_batching="sideways"),
+                  lgb.Dataset(*_data(n=60)), num_boost_round=1)
+
+
+# ------------------------------------------------------------- memmodel
+
+def test_memmodel_forest_batch_axis():
+    from lightgbm_tpu.obs import memmodel
+
+    base = dict(rows=10_000, features=50, bins=63, leaves=31)
+    one = memmodel.predict(**base)
+    explicit = memmodel.predict(forest_batch=1, **base)
+    assert one == explicit  # B=1 IS the sequential model (census pin)
+
+    b8 = memmodel.predict(forest_batch=8, **base)
+    c1, c8 = one["components"], b8["components"]
+    assert c8["dataset"] == c1["dataset"]  # the shared binned matrix
+    assert c8["scores"] == 8 * c1["scores"]
+    assert c8["grad_hess"] == 8 * c1["grad_hess"]
+    assert c8["histograms"] == 8 * c1["histograms"]
+    assert b8["params"]["forest_batch"] == 8
+    assert b8["peak_bytes"] > one["peak_bytes"]
+
+
+def test_memmodel_max_forest_batch():
+    from lightgbm_tpu.obs import memmodel
+
+    shape = dict(rows=50_000, features=64, bins=63, leaves=31)
+    cap = 2 * 2**30
+    b = memmodel.max_forest_batch(cap, **shape)
+    assert b >= 1
+    assert memmodel.predict(forest_batch=b, **shape)["peak_bytes"] <= cap
+    assert memmodel.predict(
+        forest_batch=b + 1, **shape)["peak_bytes"] > cap
+    assert memmodel.max_forest_batch(1, **shape) == 0
+
+
+# ------------------------------------------------------------ benchdiff
+
+def _forest_artifact(tmp_path, name, wall=1.0, seq=3.5, models=8,
+                     traces=1, parity_ok=True, hashes=None):
+    art = {
+        "schema": "lightgbm-tpu/forest-bench/v1",
+        "platform": "cpu",
+        "forest": {
+            "num_models": models, "rows": 128, "features": 32,
+            "num_class": 1, "rounds": 10,
+            "batched_wall_s": wall, "sequential_wall_s": seq,
+            "speedup": round(seq / wall, 3), "grow_traces": traces,
+            "forest_dispatches": 10, "forest_batched_trees": 80,
+            "parity": hashes or {f"model_{i:02d}": f"h{i}"
+                                 for i in range(models)},
+            "parity_ok": parity_ok,
+        },
+    }
+    p = tmp_path / name
+    p.write_text(json.dumps(art))
+    return str(p)
+
+
+def test_benchdiff_forest_kind(tmp_path):
+    bd = _load_tool("benchdiff")
+    old = _forest_artifact(tmp_path, "old.json")
+    assert bd.main([old, old]) == 0
+
+    # speedup collapse is a regression even with a flat batched wall
+    slow = _forest_artifact(tmp_path, "slow.json", wall=1.0, seq=1.1)
+    assert bd.main([old, slow]) == 1
+    rep = bd.diff(bd.normalize(old), bd.normalize(slow))
+    assert any("speedup" in r for r in rep["regressions"])
+
+    # broken parity is a correctness regression outright
+    bad = _forest_artifact(tmp_path, "bad.json", parity_ok=False)
+    rep = bd.diff(bd.normalize(old), bd.normalize(bad))
+    assert any("parity" in r for r in rep["regressions"])
+
+    # the one-trace contract: grow_traces growing is flagged
+    retr = _forest_artifact(tmp_path, "retrace.json", traces=8)
+    rep = bd.diff(bd.normalize(old), bd.normalize(retr))
+    assert any("grow_traces" in r for r in rep["regressions"])
+
+
+def test_benchdiff_forest_kind_mismatches_exit_2(tmp_path):
+    """Satellite 4's hard gate, in BOTH directions: forest artifacts
+    never diff against any other kind, and sweep widths must match."""
+    bd = _load_tool("benchdiff")
+    forest = _forest_artifact(tmp_path, "forest.json")
+    training = tmp_path / "training.json"
+    training.write_text(json.dumps(
+        {"metric": "leafwise", "value": 0.4, "unit": "s/tree"}))
+    assert bd.main([forest, str(training)]) == 2
+    assert bd.main([str(training), forest]) == 2
+    wider = _forest_artifact(tmp_path, "wider.json", models=16)
+    assert bd.main([forest, wider]) == 2
+
+
+# ------------------------------------------------- committed acceptance
+
+def test_committed_forest_sweep_artifact():
+    """The committed .bench/forest_sweep.json is the PR's acceptance
+    evidence: N>=8 models as ONE program (grow_traces 1) at >=3x the
+    sequential engine wall, bitwise parity intact."""
+    path = os.path.join(ROOT, ".bench", "forest_sweep.json")
+    with open(path) as fh:
+        art = json.load(fh)
+    assert art["schema"] == "lightgbm-tpu/forest-bench/v1"
+    f = art["forest"]
+    assert f["num_models"] >= 8
+    assert f["grow_traces"] == 1
+    assert f["parity_ok"] is True
+    assert len(f["parity"]) == f["num_models"]
+    assert f["speedup"] >= 3.0
+    assert os.path.exists(os.path.join(
+        ROOT, ".bench", "forest_sweep.manifest.json"))
+    bd = _load_tool("benchdiff")
+    rec = bd.normalize(path)  # and it stays benchdiff-consumable
+    assert rec["kind"] == "forest"

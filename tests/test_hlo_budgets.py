@@ -113,7 +113,8 @@ def test_memory_budgets_present_and_measured(measured, budgets):
     """Every compile-based entry point exposes memory_analysis() bytes
     AND carries committed mem_* ceilings — the static half of the HBM
     accounting (docs/memory.md); test_budgets_hold enforces them."""
-    for name in ("grow_tree_serial", "split_step_window", "place_runs",
+    for name in ("grow_tree_serial", "grow_forest_batched",
+                 "split_step_window", "place_runs",
                  "partition_window", "predict_matmul", "post_grow_step"):
         ent = budgets["entries"][name]
         assert any(k.startswith("mem_") for k in ent), (

@@ -47,8 +47,14 @@ def make_data(n, seed=13):
         z = z + rng.randn(c)[col] * 0.8
     z = (z - z.mean()) / z.std()
     y = (z + 0.6 * rng.randn(n) > 0).astype(np.float32)
+    # 3-class label from the same latent score (terciles): the
+    # multiclass variant of config 3 — K per-class trees per round are
+    # the forest-batching B-source the batched re-measure exercises
+    zn = z + 0.6 * rng.randn(n)
+    ymc = np.digitize(zn, np.quantile(zn, [1 / 3, 2 / 3])).astype(
+        np.float32)
     Xc = np.column_stack(cats).astype(np.float32)
-    return Xn, Xc, y
+    return Xn, Xc, y, ymc
 
 
 def one_hot(Xc):
@@ -68,7 +74,7 @@ def auc(y, s):
     return (r[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg)
 
 
-def train_ours(X, y, cat_idx):
+def train_ours(X, y, cat_idx, extra_params=None):
     import lightgbm_tpu as lgb
 
     os.environ.setdefault("LGBM_TPU_STOP_LAG", "4")
@@ -79,6 +85,7 @@ def train_ours(X, y, cat_idx):
         "objective": "binary", "num_leaves": LEAVES, "max_bin": BINS,
         "learning_rate": LR, "min_data_in_leaf": MIN_DATA, "verbose": -1,
     }
+    params.update(extra_params or {})
     ds = lgb.Dataset(X, label=y, categorical_feature=cat_idx or None)
     # warm the jit caches (first-iteration compile must not ride the
     # steady-state s/tree; the lru-cached hist/search factories make the
@@ -93,8 +100,12 @@ def train_ours(X, y, cat_idx):
     elapsed = time.perf_counter() - t0
     log(f"  cold (2 trees + compile): {cold_s:.2f}s; "
         f"warm: {elapsed / TREES:.4f}s/tree x {TREES}")
-    pred = bst.predict(X, raw_score=True)
-    return elapsed / TREES, auc(y, np.asarray(pred))
+    pred = np.asarray(bst.predict(X, raw_score=True))
+    if pred.ndim == 2:  # multiclass: accuracy replaces AUC
+        score = float((pred.argmax(axis=1) == y).mean())
+    else:
+        score = auc(y, pred)
+    return elapsed / TREES, score, bst
 
 
 def train_ref(exe, csv_path, n_cols, cat_idx, tag):
@@ -143,21 +154,53 @@ def main():
     if not require_tpu_or_row(platform, rows=ROWS):
         return
 
-    Xn, Xc, y = make_data(ROWS)
+    Xn, Xc, y, ymc = make_data(ROWS)
     X_direct = np.column_stack([Xn, Xc])
     cat_idx = list(range(N_NUM, N_NUM + len(CARDS)))
     results = {}
 
     log("ours direct-categorical ...")
-    s, a = train_ours(X_direct, y, cat_idx)
+    s, a, _ = train_ours(X_direct, y, cat_idx)
     results["ours_direct"] = {"sec_per_tree": round(s, 4), "auc": round(a, 4)}
     log(f"  {s:.3f}s/tree AUC={a:.4f}")
 
     log("ours one-hot ...")
     X_oh = np.column_stack([Xn, one_hot(Xc)])
-    s, a = train_ours(X_oh, y, [])
+    s, a, _ = train_ours(X_oh, y, [])
     results["ours_onehot"] = {"sec_per_tree": round(s, 4), "auc": round(a, 4)}
     log(f"  {s:.3f}s/tree AUC={a:.4f}")
+
+    if os.environ.get("CATBENCH_MULTICLASS", "1") != "0":
+        # multiclass variant (3-class terciles of the same latent): the
+        # K per-class trees per round route through the batched forest
+        # dispatch (learners/forest.py) when forest_batching=on — one
+        # launch per round instead of K — and must stay BITWISE equal
+        # to the sequential per-class loop (forest_batching=off)
+        import hashlib
+
+        mc = {"objective": "multiclass", "num_class": 3}
+        log("ours multiclass direct, batched per-class trees ...")
+        s, a, bst_b = train_ours(X_direct, ymc, cat_idx,
+                                 {**mc, "forest_batching": "on"})
+        results["ours_mc_batched"] = {
+            "sec_per_tree": round(s, 4), "accuracy": round(a, 4)}
+        log(f"  {s:.3f}s/tree acc={a:.4f}")
+        log("ours multiclass direct, sequential per-class trees ...")
+        s, a, bst_s = train_ours(X_direct, ymc, cat_idx,
+                                 {**mc, "forest_batching": "off"})
+        results["ours_mc_sequential"] = {
+            "sec_per_tree": round(s, 4), "accuracy": round(a, 4)}
+        log(f"  {s:.3f}s/tree acc={a:.4f}")
+        results["mc_batched_parity"] = (
+            hashlib.sha256(bst_b.model_to_string().encode()).hexdigest()
+            == hashlib.sha256(
+                bst_s.model_to_string().encode()).hexdigest())
+        results["mc_batched_speedup"] = round(
+            results["ours_mc_sequential"]["sec_per_tree"]
+            / results["ours_mc_batched"]["sec_per_tree"], 2)
+        log(f"  batched vs sequential: "
+            f"{results['mc_batched_speedup']}x, parity "
+            f"{'OK' if results['mc_batched_parity'] else 'BROKEN'}")
 
     if os.environ.get("CATBENCH_SKIP_REF", "0") == "0":
         import bench
@@ -190,6 +233,23 @@ def main():
                 o["sec_per_tree"] / d["sec_per_tree"], 2)
     results["platform"] = platform
     print(json.dumps({"rows": ROWS, "trees": TREES, **results}))
+    out = os.environ.get("CATBENCH_OUT")
+    if out:
+        # benchdiff-ready row (raw bench-row shape: metric/value/unit):
+        # the headline stays ours-direct s/tree so the row diffs
+        # cleanly against the committed config-3 baseline
+        from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+        atomic_write_json(out, {
+            "metric": "categorical_config3_ours_direct",
+            "value": results["ours_direct"]["sec_per_tree"],
+            "unit": "s/tree",
+            "platform": platform,
+            "train_auc": results["ours_direct"]["auc"],
+            "rows": ROWS, "trees": TREES,
+            "results": results,
+        })
+        log(f"wrote {out}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""bench_forest: the forest-batching sweep — N small models, ONE program.
+
+Measures the tentpole claim of the batched forest dispatch
+(lightgbm_tpu/learners/forest.py + models/gbdt.py train_forest_round):
+training N independent small models through ``train_many`` — one fused
+grow dispatch advancing the whole forest each round — beats the
+sequential engine loop (the same N models trained one ``update()`` at a
+time) by the committed speedup floor, while staying BITWISE equal to it
+per model and tracing the grower exactly once for all N lanes.
+
+Commits a ``.bench/forest_sweep.json`` artifact (schema
+``lightgbm-tpu/forest-bench/v1``, diffable with tools/benchdiff.py
+against any prior forest artifact) plus its run manifest:
+
+* ``batched_wall_s``     — warm wall of ``train_many`` over all N models
+* ``sequential_wall_s``  — warm wall of the per-model ``train`` loop
+* ``speedup``            — sequential / batched (the headline claim)
+* ``grow_traces``        — grower traces across the ENTIRE batched
+  phase, cold run included (1 = one program for the whole forest; N
+  would mean trace-per-model snuck back)
+* ``parity``/``parity_ok`` — per-model sha256 of the trained model
+  string, batched vs sequential (bitwise contract, not a tolerance)
+
+Both paths warm up on a full cold run first, so the timed walls compare
+steady-state dispatch, not compile time — the regime a multi-tenant
+"B models per chip" deployment lives in.
+
+Usage:
+    FORESTBENCH_PLATFORM=cpu python tools/bench_forest.py
+    python tools/bench_forest.py --models 8 --rows 256 --rounds 10
+
+Exit codes: 0 = speedup floor met and parity holds, 1 = floor missed
+or parity broken (artifact still written), 2 = bad arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault(
+    "JAX_PLATFORMS", os.environ.get("FORESTBENCH_PLATFORM", "cpu"))
+
+SCHEMA = "lightgbm-tpu/forest-bench/v1"
+
+
+def _make_data(rows: int, features: int, seed: int = 7):
+    import numpy as np
+
+    r = np.random.RandomState(seed)
+    X = r.randn(rows, features).astype(np.float32)
+    w = r.randn(features)
+    y = (X @ w + 0.3 * r.randn(rows) > 0).astype(np.float32)
+    return X, y
+
+
+def _model_params(i: int, args, forest_batching: str) -> dict:
+    """Per-model params: one traced shape (num_leaves/max_bin fixed),
+    everything else varied per lane — the heterogeneity train_many
+    promises to batch."""
+    return {
+        "objective": "binary",
+        "num_leaves": args.leaves,
+        "max_bin": args.max_bin,
+        "learning_rate": 0.05 + 0.01 * i,
+        "lambda_l2": 0.1 * (1 + i % 4),
+        "min_data_in_leaf": 5 + i % 3,
+        "seed": 100 + i,
+        "verbose": -1,
+        "forest_batching": forest_batching,
+    }
+
+
+def _hash_model(bst) -> str:
+    return hashlib.sha256(bst.model_to_string().encode()).hexdigest()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--models", type=int, default=8,
+                    help="forest width N (default 8)")
+    ap.add_argument("--rows", type=int, default=128)
+    ap.add_argument("--features", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--leaves", type=int, default=15)
+    ap.add_argument("--max-bin", type=int, default=63)
+    ap.add_argument("--min-speedup", type=float, default=3.0,
+                    help="committed speedup floor (default 3.0)")
+    ap.add_argument("--out",
+                    default=os.path.join(ROOT, ".bench",
+                                         "forest_sweep.json"))
+    args = ap.parse_args(argv)
+    if args.models < 2:
+        print("bench_forest: --models must be >= 2", file=sys.stderr)
+        return 2
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.manifest import RunManifest, manifest_path
+    from lightgbm_tpu.obs.telemetry import get_telemetry
+    from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+    X, y = _make_data(args.rows, args.features)
+    ds = lgb.Dataset(X, label=y)
+    tel = get_telemetry()
+
+    def run_batched():
+        plist = [_model_params(i, args, "on") for i in range(args.models)]
+        return lgb.train_many(plist, ds, num_boost_round=args.rounds)
+
+    def run_sequential():
+        out = []
+        for i in range(args.models):
+            p = _model_params(i, args, "off")
+            out.append(lgb.train(p, ds, num_boost_round=args.rounds,
+                                 verbose_eval=False))
+        return out
+
+    # cold pass (traces + compiles land here); grow_traces across the
+    # whole batched phase is the one-program evidence
+    tel.reset()
+    run_batched()
+    t0 = time.perf_counter()
+    bst_batched = run_batched()
+    batched_wall = time.perf_counter() - t0
+    snap = tel.snapshot().get("counters", {})
+    grow_traces = int(snap.get("grow_traces", 0))
+    dispatches = int(snap.get("forest_dispatches", 0))
+    batched_trees = int(snap.get("forest_batched_trees", 0))
+
+    run_sequential()
+    t0 = time.perf_counter()
+    bst_seq = run_sequential()
+    sequential_wall = time.perf_counter() - t0
+
+    hashes_b = [_hash_model(b) for b in bst_batched]
+    hashes_s = [_hash_model(b) for b in bst_seq]
+    parity_ok = hashes_b == hashes_s
+    speedup = sequential_wall / batched_wall if batched_wall else 0.0
+
+    import jax
+
+    artifact = {
+        "schema": SCHEMA,
+        "platform": jax.devices()[0].platform,
+        "forest": {
+            "num_models": args.models,
+            "rows": args.rows,
+            "features": args.features,
+            "num_class": 1,
+            "rounds": args.rounds,
+            "leaves": args.leaves,
+            "max_bin": args.max_bin,
+            "batched_wall_s": round(batched_wall, 6),
+            "sequential_wall_s": round(sequential_wall, 6),
+            "speedup": round(speedup, 3),
+            "min_speedup": args.min_speedup,
+            "grow_traces": grow_traces,
+            "forest_dispatches": dispatches,
+            "forest_batched_trees": batched_trees,
+            "parity": {f"model_{i:02d}": h
+                       for i, h in enumerate(hashes_b)},
+            "parity_ok": parity_ok,
+        },
+        "knobs": {k: v for k, v in os.environ.items()
+                  if k.startswith("LGBM_TPU_")},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    atomic_write_json(args.out, artifact)
+    RunManifest.collect(
+        entry="bench_forest.py",
+        result={"metric": "forest_batched_wall",
+                "value": round(batched_wall, 6), "unit": "s batched-wall",
+                "speedup": round(speedup, 3),
+                "num_models": args.models},
+    ).write(manifest_path(args.out))
+
+    print(f"bench_forest: N={args.models} rows={args.rows} "
+          f"rounds={args.rounds} on {artifact['platform']}")
+    print(f"  batched    {batched_wall:.4f}s  (one program: "
+          f"{grow_traces} grow trace(s), {dispatches} dispatches, "
+          f"{batched_trees} trees)")
+    print(f"  sequential {sequential_wall:.4f}s")
+    print(f"  speedup    {speedup:.2f}x (floor {args.min_speedup:.1f}x)")
+    print(f"  parity     {'OK (bitwise, all models)' if parity_ok else 'BROKEN'}")
+    print(f"  artifact   {args.out}")
+
+    if not parity_ok:
+        for i, (hb, hs) in enumerate(zip(hashes_b, hashes_s)):
+            if hb != hs:
+                print(f"  model {i}: batched {hb[:16]} != "
+                      f"sequential {hs[:16]}", file=sys.stderr)
+        return 1
+    if speedup < args.min_speedup:
+        print(f"bench_forest: speedup {speedup:.2f}x below floor "
+              f"{args.min_speedup:.1f}x", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,20 @@ Accepted input formats (auto-detected per file):
   regress 3x while the headline hides it in noise.  Batch mode diffs
   file-to-file seconds.  Serving and training artifacts are never
   cross-compared (exit 2).
+* forest bench artifacts  (``.bench/forest_sweep.json`` —
+  ``lightgbm-tpu/forest-bench/v1`` from tools/bench_forest.py):
+  headline is the batched forest wall (ONE program advancing all N
+  models), diffed under the headline threshold; the
+  batched-vs-sequential speedup dropping past the headline threshold
+  is a regression even when the batched wall itself stays flat (the
+  sequential side got faster and batching stopped paying); a batched
+  run whose per-model parity hashes no longer match its own sequential
+  replay (``parity_ok`` false) is flagged as a correctness regression,
+  and ``grow_traces`` growing means the one-trace contract broke
+  (trace-per-model came back).  Model counts must match (exit 2 —
+  an 8-model sweep and a 16-model sweep are not comparable), and
+  forest artifacts are never cross-compared with any other kind
+  (exit 2).
 
 Usage:
     python tools/benchdiff.py OLD NEW [--threshold PCT]
@@ -59,6 +73,7 @@ AUC_ABS = 0.002  # an AUC drop is a correctness smell, not a perf one
 MANIFEST_SCHEMA = "lightgbm-tpu/run-manifest/v1"
 SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
 MULTICHIP_SCHEMA = "lightgbm-tpu/multichip-bench/v1"
+FOREST_SCHEMA = "lightgbm-tpu/forest-bench/v1"
 # cross-rank skew gate: a skew below this absolute floor is scheduling
 # noise on any backend — relative growth only matters above it
 SKEW_ABS_FLOOR_S = 0.02
@@ -98,6 +113,33 @@ def _normalize_serving(raw: dict, rec: dict) -> dict:
         raise ValueError(
             f"{rec['path']}: serving artifact has no usable headline "
             f"({'file_to_file_s' if rec['mode'] == 'batch' else 'p50_ms'})")
+    return rec
+
+
+def _normalize_forest(raw: dict, rec: dict) -> dict:
+    """Forest-bench artifacts (tools/bench_forest.py): headline is the
+    batched wall — the one dispatch-per-round program advancing all N
+    models; the sequential wall / speedup / parity hashes / trace
+    counters ride in ``aux`` for the forest-specific diff."""
+    f = dict(raw.get("forest") or {})
+    rec["kind"] = "forest"
+    rec["num_models"] = f.get("num_models")
+    rec["value"] = f.get("batched_wall_s")
+    rec["unit"] = "s batched-wall"
+    rec["aux"] = {k: f.get(k) for k in
+                  ("sequential_wall_s", "speedup", "rounds", "rows",
+                   "features", "num_class", "grow_traces",
+                   "forest_dispatches", "forest_batched_trees")
+                  if f.get(k) is not None}
+    rec["parity"] = dict(f.get("parity") or {})
+    rec["parity_ok"] = f.get("parity_ok")
+    rec["shape"] = {k: f.get(k) for k in
+                    ("rows", "features", "num_class", "rounds")}
+    rec["knobs"] = raw.get("knobs") or {}
+    if rec.get("value") in (None, 0, 0.0):
+        raise ValueError(
+            f"{rec['path']}: forest artifact has no usable headline "
+            "(forest.batched_wall_s)")
     return rec
 
 
@@ -146,6 +188,8 @@ def normalize(path: str) -> dict:
     raw = _load(path)
     rec: dict = {"label": os.path.basename(path), "path": path,
                  "phases": {}, "sha": None, "kind": "training"}
+    if raw.get("schema") == FOREST_SCHEMA:
+        return _normalize_forest(raw, rec)
     if raw.get("schema") == MULTICHIP_SCHEMA:
         return _normalize_multichip(raw, rec)
     if raw.get("schema") == SERVING_SCHEMA or "serving" in raw:
@@ -317,6 +361,87 @@ def diff_serving(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
             "warnings": warnings, "improvements": improvements}
 
 
+def diff_forest(old: dict, new: dict,
+                headline_pct: float = HEADLINE_PCT,
+                phase_pct: float = PHASE_PCT) -> dict:
+    """Forest-bench comparison: the batched wall under the usual
+    headline threshold, PLUS the gates that keep the batching honest —
+    the batched-vs-sequential speedup must not shrink past the headline
+    threshold (a flat batched wall over a faster sequential engine
+    means the fused dispatch stopped paying), ``parity_ok`` false is a
+    correctness regression outright (the batched trees diverged from
+    their own sequential replay), and a ``grow_traces`` count that grew
+    means the one-trace-for-all-models contract broke."""
+    regressions, warnings, improvements = [], [], []
+    if old.get("num_models") != new.get("num_models"):
+        raise ValueError(
+            f"forest model counts differ (old: {old.get('num_models')}, "
+            f"new: {new.get('num_models')}) — batched walls across "
+            "different sweep widths are not comparable")
+    unit = new.get("unit", "s")
+    ov, nv = float(old["value"]), float(new["value"])
+    head = _pct(ov, nv)
+    headline = {"old": ov, "new": nv, "unit": unit,
+                "delta_pct": round(head, 1),
+                "num_models": new.get("num_models")}
+    if head >= headline_pct:
+        regressions.append(
+            f"headline {unit} {ov:.4g} -> {nv:.4g} (+{head:.1f}%, "
+            f"threshold +{headline_pct:.0f}%)")
+    elif head <= -headline_pct:
+        improvements.append(
+            f"headline {unit} {ov:.4g} -> {nv:.4g} ({head:.1f}%)")
+
+    oa, na = old.get("aux") or {}, new.get("aux") or {}
+    osp, nsp = oa.get("speedup"), na.get("speedup")
+    if osp and nsp:
+        d = _pct(float(osp), float(nsp))
+        if d <= -headline_pct:
+            regressions.append(
+                f"batched-vs-sequential speedup {osp:.2f}x -> {nsp:.2f}x "
+                f"({d:.1f}%, threshold -{headline_pct:.0f}%) — the fused "
+                "dispatch pays less than it used to")
+        elif d >= headline_pct:
+            improvements.append(
+                f"batched-vs-sequential speedup {osp:.2f}x -> {nsp:.2f}x "
+                f"({d:+.1f}%)")
+    if nsp is not None and float(nsp) < 1.0:
+        regressions.append(
+            f"NEW speedup {float(nsp):.2f}x < 1 — the batched program is "
+            "slower than the sequential loop it replaces")
+
+    # correctness gates: these are never perf tradeoffs
+    if new.get("parity_ok") is False:
+        regressions.append(
+            "NEW run's per-model parity hashes do not match the "
+            "sequential replay (parity_ok false) — the batched grower "
+            "diverged from the tree-by-tree path")
+    ot = oa.get("grow_traces")
+    nt = na.get("grow_traces")
+    if nt is not None and ot is not None and int(nt) > int(ot):
+        regressions.append(
+            f"grow_traces {ot} -> {nt} — the batched sweep retraces; "
+            "one-program-for-the-forest no longer holds")
+    op_, np_ = old.get("parity") or {}, new.get("parity") or {}
+    if op_ and np_ and sorted(op_) == sorted(np_) and op_ != np_:
+        changed = sorted(k for k in op_ if op_[k] != np_.get(k))
+        warnings.append(
+            "per-model parity hashes changed vs the OLD artifact "
+            f"({len(changed)}/{len(op_)} models: "
+            + ", ".join(changed[:4])
+            + (" ..." if len(changed) > 4 else "")
+            + ") — the trained trees themselves moved, expected only "
+            "after an intentional numerics change")
+
+    os_, ns = old.get("shape") or {}, new.get("shape") or {}
+    if os_ and ns and os_ != ns:
+        warnings.append(
+            f"sweep shapes differ (old: {os_}, new: {ns}) — comparison "
+            "may not be apples-to-apples")
+    return {"headline": headline, "regressions": regressions,
+            "warnings": warnings, "improvements": improvements}
+
+
 def diff_multichip(old: dict, new: dict,
                    headline_pct: float = HEADLINE_PCT,
                    phase_pct: float = PHASE_PCT) -> dict:
@@ -420,6 +545,13 @@ def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
     """Compare two normalized records; returns
     ``{regressions: [...], warnings: [...], improvements: [...],
     headline: {...}}``."""
+    if "forest" in (old.get("kind"), new.get("kind")):
+        if old.get("kind") != new.get("kind"):
+            raise ValueError(
+                f"{old['label']} is a {old.get('kind')} artifact, "
+                f"{new['label']} is a {new.get('kind')} artifact — "
+                "forest-bench and other results are not comparable")
+        return diff_forest(old, new, headline_pct, phase_pct)
     if "multichip" in (old.get("kind"), new.get("kind")):
         if old.get("kind") != new.get("kind"):
             raise ValueError(
@@ -582,6 +714,10 @@ def main(argv: Optional[list] = None) -> int:
     if new.get("kind") == "multichip":
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta}) at world={h.get('world')}")
+    elif new.get("kind") == "forest":
+        print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
+              f"{h['unit']} ({delta}) at num_models="
+              f"{h.get('num_models')}")
     elif new.get("kind") == "serving":
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta})")
@@ -594,7 +730,7 @@ def main(argv: Optional[list] = None) -> int:
         print(f"  warning: {w}")
     for i in report["improvements"]:
         print(f"  improvement: {i}")
-    if new.get("kind") not in ("serving", "multichip"):
+    if new.get("kind") not in ("serving", "multichip", "forest"):
         print("  driver-config row (paste into the commit message):")
         print("  " + driver_row(new))
 

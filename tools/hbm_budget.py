@@ -67,7 +67,9 @@ def render(curve: dict) -> list:
         f" | features={p['features']} bins={p['bins']}"
         f" leaves={p['leaves']} num_class={p['num_class']}"
         f" world={p['world']} routing={p['routing']}"
-        f" hist_prec={p['hist_prec']}",
+        f" hist_prec={p['hist_prec']}"
+        + (f" forest_batch={p['forest_batch']}"
+           if p.get("forest_batch", 1) > 1 else ""),
         f"{'rows':>12}  {'predicted peak':>14}  {'peak phase':<12} fits",
     ]
     for pt in curve["points"]:
@@ -111,6 +113,11 @@ def main(argv=None) -> int:
                     default="prefix")
     ap.add_argument("--hist-prec", choices=("float32", "float64"),
                     default="float32")
+    ap.add_argument("--forest-batch", type=int, default=0, metavar="B",
+                    help="forest-batched training (learners/forest.py): "
+                    "report the predicted peak with B models batched at "
+                    "each row point, plus the max B that fits at the "
+                    "smallest requested shape")
     ap.add_argument("--json", help="also write the curve dict here")
     args = ap.parse_args(argv)
 
@@ -120,13 +127,35 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"hbm_budget: {e}", file=sys.stderr)
         return 2
+    if args.forest_batch < 0:
+        print("hbm_budget: --forest-batch must be >= 1", file=sys.stderr)
+        return 2
 
     curve = memmodel.rows_curve(
         capacity, rows, features=args.features, bins=args.bins,
         leaves=args.leaves, num_class=args.num_class, world=args.world,
-        routing=args.routing, hist_prec=args.hist_prec)
+        routing=args.routing, hist_prec=args.hist_prec,
+        forest_batch=max(args.forest_batch, 1))
     for line in render(curve):
         print(line)
+    if args.forest_batch:
+        # sizing input for picking B on chip: how many batched models
+        # fit at each requested shape
+        for r in rows:
+            max_b = memmodel.max_forest_batch(
+                capacity, rows=r, features=args.features, bins=args.bins,
+                leaves=args.leaves, num_class=args.num_class,
+                world=args.world, routing=args.routing,
+                hist_prec=args.hist_prec)
+            print(f"max forest-batch B at rows={r:,}: {max_b}")
+        curve["max_forest_batch"] = {
+            str(r): memmodel.max_forest_batch(
+                capacity, rows=r, features=args.features, bins=args.bins,
+                leaves=args.leaves, num_class=args.num_class,
+                world=args.world, routing=args.routing,
+                hist_prec=args.hist_prec)
+            for r in rows
+        }
     if args.json:
         from lightgbm_tpu.resilience.atomic import atomic_write_json
 
