@@ -14,16 +14,29 @@ unmeasured; ROADMAP "Recent").
   op counts, donation aliasing, and the single-mention aliased record
   chain; ``recompile`` provides the process-wide backend-compile
   counter the bench warm-up and the steady-loop gate use.
+* Stage 3 (``concurrency``): lock-discipline lint of the threaded
+  serving/obs/resilience tier — shared-state guards, lock-order
+  cycles, device syncs under locks, signal-handler lock safety; its
+  runtime twin ``lockcheck`` is the env-gated (``LGBM_TPU_LOCKCHECK``)
+  instrumented-lock sanitizer those modules create primitives through.
 
-Both stages are wired into tier-1 (tests/test_jaxlint.py,
-tests/test_hlo_budgets.py) and the standalone ``tools/jaxlint.py`` CLI.
+All stages are wired into tier-1 (tests/test_jaxlint.py,
+tests/test_hlo_budgets.py, tests/test_concurrency_analysis.py) and the
+standalone ``tools/jaxlint.py`` CLI.
 """
 
+from . import lockcheck  # noqa: F401
 from .ast_rules import (  # noqa: F401
     AST_RULES,
     Finding,
     lint_paths,
     lint_source,
+)
+from .concurrency import (  # noqa: F401
+    CONCURRENCY_RULES,
+    lint_concurrency_paths,
+    lint_concurrency_source,
+    lint_concurrency_sources,
 )
 from .hlo_audit import (  # noqa: F401
     ARTIFACT_RULES,

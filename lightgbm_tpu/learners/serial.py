@@ -69,6 +69,27 @@ from ..ops.split import (
     SplitResult, find_best_split, find_best_split_leaves, K_MIN_SCORE)
 
 
+# leaf_count/internal_count ride the histogram count channel, which is
+# float32 under the default hist_dtype: integers are exact in float32
+# only up to 2**24, so a single leaf holding more than ~16.7M rows
+# would silently round its count (and the min_data_in_leaf comparisons
+# on it).  Row count bounds every leaf count, so the envelope is
+# checked once per reset_training_data against n (ADVICE r5).
+F32_COUNT_EXACT_ROWS = 1 << 24
+
+
+def check_count_envelope(num_rows: int, hist_dtype: str) -> None:
+    """Reject datasets whose row count can overflow the float32
+    integer-exact range in the count channel."""
+    if hist_dtype == "float32" and num_rows > F32_COUNT_EXACT_ROWS:
+        raise ValueError(
+            f"num_data={num_rows} exceeds the float32 integer-exact "
+            f"envelope ({F32_COUNT_EXACT_ROWS} = 2**24) for the "
+            "histogram count channel: leaf_count/internal_count could "
+            "round silently.  Set hist_dtype=float64 (the reference's "
+            "double accumulation) for datasets this large.")
+
+
 class TreeLearnerParams(NamedTuple):
     """Scalar tree-growth constraints (TreeConfig, config.h:165-190)."""
 

@@ -32,7 +32,8 @@ from ..obs import telemetry
 from ..resilience import faults
 from ..resilience.atomic import atomic_write
 from ..obs.device_time import phase_scope
-from ..learners.serial import TreeLearnerParams, grow_tree
+from ..learners.serial import (
+    TreeLearnerParams, check_count_envelope, grow_tree)
 from ..metrics import Metric, create_metrics
 from ..objectives import ObjectiveFunction, create_objective
 from .tree import (
@@ -168,6 +169,7 @@ class GBDT:
         self.train_set = train_set
         self.objective = objective
         n = train_set.num_data
+        check_count_envelope(n, self.config.hist_dtype)
         self.num_data = n
         self.max_feature_idx = train_set.num_total_features - 1
         self.feature_names = list(train_set.feature_names)

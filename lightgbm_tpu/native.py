@@ -13,11 +13,11 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .analysis import lockcheck
 from .log import Log
 
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lib")
@@ -26,7 +26,7 @@ _SRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "src", "native", "lgbm_native.cpp",
 )
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

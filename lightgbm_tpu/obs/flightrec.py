@@ -41,9 +41,10 @@ from __future__ import annotations
 import collections
 import itertools
 import os
-import threading
 import time
 from typing import Deque, Dict, List, Optional
+
+from ..analysis import lockcheck
 
 SCHEMA = "lightgbm-tpu/flightrec/v1"
 
@@ -65,7 +66,12 @@ _EVENTS: Deque[dict] = collections.deque(maxlen=max(1, _ENV_CAP))
 # unique and contiguous across threads
 _SEQ = itertools.count()
 _STATE: Dict[str, object] = {"dir": _ENV_DIR, "rank": None}
-_DUMP_LOCK = threading.Lock()
+# RLock, not Lock: dump() runs from signal handlers (checkpoint's
+# second-signal abort path), and a signal delivered while the main
+# thread is mid-dump would re-enter a plain Lock and self-deadlock —
+# the same hazard the telemetry store RLock exists for (jaxlint
+# signal-unsafe-lock)
+_DUMP_LOCK = lockcheck.make_rlock("flightrec.dump")
 
 
 def set_rank(rank: Optional[int]) -> None:

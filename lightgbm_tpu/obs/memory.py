@@ -33,9 +33,10 @@ of ``obs/``.  See docs/memory.md for the gauge-name contract.
 from __future__ import annotations
 
 import itertools
-import threading
 import weakref
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+from ..analysis import lockcheck
 
 GAUGE_PREFIX = "lgbm_memory_"
 
@@ -53,7 +54,7 @@ BOUNDARIES = ("binning", "train", "eval", "serve", "swap")
 OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
                "OOM when allocating")
 
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("memory.census")
 _enabled = True
 
 # token -> (tag, weakref-to-owner, getter).  getter(owner) returns a

@@ -53,10 +53,11 @@ import bisect
 import json
 import re
 import sys
-import threading
 import time
 from os import environ as _environ
 from typing import Dict, List, Optional
+
+from ..analysis import lockcheck
 
 # read once at import — see module docstring
 TELEMETRY_MODE = _environ.get("LGBM_TPU_TELEMETRY", "on").strip().lower()
@@ -257,7 +258,7 @@ class Telemetry:
         # lock, a non-reentrant lock would deadlock the "Ctrl-C twice"
         # abort.  Re-entry can at worst lose the interrupted frame's
         # single increment; a hang needs SIGKILL.
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("telemetry.store")
         self._counters: Dict[str, float] = {}
         self._spans: Dict[str, SpanStat] = {}
         self._reservoirs: Dict[str, Reservoir] = {}

@@ -415,7 +415,11 @@ class CheckpointManager:
                           self._old_handlers.get(signum, signal.SIG_DFL))
             os.kill(os.getpid(), signum)
             return
-        self._stop_signum = signum
+        # invariant: signals are delivered on the MAIN thread between
+        # bytecodes, and a single reference assignment is atomic under
+        # the GIL — a lock here could self-deadlock the handler, and
+        # the training loop only ever reads this flag once per round
+        self._stop_signum = signum  # jaxlint: disable=shared-state-unlocked
         flightrec.record("signal", signal=signal.Signals(signum).name,
                          second=False)
         Log.warning(
@@ -427,7 +431,13 @@ class CheckpointManager:
     def __enter__(self) -> "CheckpointManager":
         try:
             for sig in (signal.SIGTERM, signal.SIGINT):
-                self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+                # invariant: this write happens-before any delivery of
+                # the handler being registered (signal.signal returns
+                # only after installation), and _old_handlers is
+                # read-only afterwards — no interleaving can observe a
+                # partial dict
+                self._old_handlers[sig] = signal.signal(  # jaxlint: disable=shared-state-unlocked
+                    sig, self._on_signal)
         except ValueError:
             # not the main thread (embedded use): periodic snapshots
             # still work, signal capture does not

@@ -38,7 +38,6 @@ response the offline predictor would have given.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -47,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import lockcheck
 from ..log import Log
 from ..obs import flightrec, telemetry
 from ..obs import memory as obs_memory
@@ -69,7 +69,7 @@ def _raw_bucket_scores(tables, stacked, X):
 # so importing this module never initializes a jax backend (the
 # donation decision needs jax.default_backend()).
 _DISPATCH = None
-_DISPATCH_LOCK = threading.Lock()
+_DISPATCH_LOCK = lockcheck.make_lock("engine.dispatch_init")
 
 
 def _bucket_dispatch():
@@ -200,7 +200,7 @@ class ServingEngine:
             raise ValueError(f"invalid bucket set {buckets!r}")
         self.buckets: Tuple[int, ...] = tuple(buckets)
         self.max_batch_rows = self.buckets[-1]
-        self._swap_lock = threading.Lock()
+        self._swap_lock = lockcheck.make_lock("engine.swap")
         self._active = pm
         # monotonic adoption timestamp: healthz reports its age so a
         # load balancer can tell "just flipped" from "steady" (set at
@@ -291,6 +291,7 @@ class ServingEngine:
             # classifier path a real RESOURCE_EXHAUSTED takes
             faults.maybe_oom_dispatch("serve")
             out = _bucket_dispatch()(pm.tables, pm.stacked, Xj)
+            lockcheck.note_host_sync("engine.dispatch_rows")
             res = np.asarray(out, np.float64)[:, :n]
         except Exception as e:
             obs_memory.classify_dispatch_error(
@@ -359,6 +360,7 @@ class ServingEngine:
         for b in self.buckets:
             Xz = jnp.asarray(np.zeros((b, pm.num_features), np.float32))
             out = _bucket_dispatch()(pm.tables, pm.stacked, Xz)
+            lockcheck.note_host_sync("engine.prewarm")
             out.block_until_ready()
             pm.warmed_buckets.add(b)
         compiles = cc.delta()

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..analysis import lockcheck
 from ..obs import flightrec, telemetry, tracing
 
 DEFAULT_MAX_DELAY_S = 0.002
@@ -108,7 +109,7 @@ class MicroBatchQueue:
         if self._max_rows < 1:
             raise ValueError("max_batch_rows must be >= 1")
         self._raw_score = bool(raw_score)
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("queue.cond")
         self._pending: collections.deque = collections.deque()
         self._pending_rows = 0
         self._closed = False

@@ -1,0 +1,14 @@
+"""Known-bad: a host materialization inside a lock's critical section.
+Must trigger device-sync-under-lock exactly once."""
+
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_buf = []
+
+
+def snapshot():
+    with _lock:
+        return np.asarray(_buf)

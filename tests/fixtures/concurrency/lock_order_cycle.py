@@ -1,0 +1,19 @@
+"""Known-bad: two locks taken in opposite orders on two paths.  Must
+trigger lock-order-cycle exactly once (one finding per cycle)."""
+
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def left():
+    with _a:
+        with _b:
+            return 1
+
+
+def right():
+    with _b:
+        with _a:
+            return 2

@@ -1,0 +1,19 @@
+"""Known-bad: thread-entry writes an attribute the caller side reads,
+with no common lock.  Must trigger shared-state-unlocked exactly once
+(on the unguarded write in the thread loop)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self.items += 1
+
+    def total(self):
+        return self.items

@@ -1,0 +1,20 @@
+"""Known-bad: a plain Lock acquired on a path reachable from a signal
+handler.  Must trigger signal-unsafe-lock exactly once."""
+
+import signal
+import threading
+
+_lock = threading.Lock()
+_events = []
+
+
+def flush():
+    with _lock:
+        return list(_events)
+
+
+def _on_sigterm(signum, frame):
+    flush()
+
+
+signal.signal(signal.SIGTERM, _on_sigterm)

@@ -1,0 +1,380 @@
+"""Tier-1 gate for jaxlint stage 3 (concurrency analysis).
+
+Same discipline as the stage-1 tests: every rule is pinned on a
+minimal synthetic positive AND a negative control, the suppression
+pragmas round-trip on stage-3 rule ids, and the known-bad fixture
+corpus (tests/fixtures/concurrency/) triggers each rule exactly once —
+so a rule that silently stops matching (or starts over-matching) fails
+here before it lets a real race through.
+"""
+
+import os
+import textwrap
+
+from lightgbm_tpu.analysis import (
+    CONCURRENCY_RULES,
+    lint_concurrency_source,
+    lint_concurrency_sources,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "concurrency")
+
+SERVING = "lightgbm_tpu/serving/mod.py"
+RESILIENCE = "lightgbm_tpu/resilience/mod.py"
+
+
+def _rules(src: str, path: str = SERVING) -> set:
+    return {f.rule
+            for f in lint_concurrency_source(textwrap.dedent(src),
+                                             path=path)}
+
+
+# --------------------------------------------------------- rule table
+
+def test_rule_table_complete():
+    assert set(CONCURRENCY_RULES) == {
+        "shared-state-unlocked", "lock-order-cycle",
+        "device-sync-under-lock", "signal-unsafe-lock",
+    }
+
+
+# ----------------------------------------------- shared-state-unlocked
+
+def test_shared_state_guarded_is_fine():
+    src = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = 0
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            with self._lock:
+                self.items += 1
+
+        def total(self):
+            with self._lock:
+                return self.items
+    """
+    assert "shared-state-unlocked" not in _rules(src)
+
+
+def test_shared_state_no_thread_entry_is_fine():
+    # same unguarded writes, but no thread ever enters the class
+    src = """
+    class Plain:
+        def __init__(self):
+            self.items = 0
+
+        def bump(self):
+            self.items += 1
+
+        def total(self):
+            return self.items
+    """
+    assert "shared-state-unlocked" not in _rules(src)
+
+
+def test_condition_wait_marks_thread_entry():
+    # the Condition.wait consumer is the thread side even without an
+    # explicit Thread(target=...) in this module
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.depth = 0
+
+        def _consume(self):
+            with self._cond:
+                self._cond.wait()
+            self.depth -= 1
+
+        def put(self):
+            self.depth += 1
+    """
+    assert "shared-state-unlocked" in _rules(src)
+
+
+def test_single_read_swap_pattern_is_fine():
+    # engine.py's pattern: writes all guarded, readers take ONE
+    # unguarded reference read — writes share a common guard, so no
+    # finding (the single-read discipline is the documented invariant)
+    src = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._swap_lock = threading.Lock()
+            self._active = None
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            pm = self._active
+            return pm
+
+        def swap(self, new):
+            with self._swap_lock:
+                self._active = new
+    """
+    assert "shared-state-unlocked" not in _rules(src)
+
+
+# --------------------------------------------------- lock-order-cycle
+
+def test_lock_order_consistent_is_fine():
+    src = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def one():
+        with _a:
+            with _b:
+                return 1
+
+    def two():
+        with _a:
+            with _b:
+                return 2
+    """
+    assert "lock-order-cycle" not in _rules(src)
+
+
+def test_lock_order_cycle_through_call_fires():
+    # the inversion hides behind a call made while _a is held
+    src = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def inner():
+        with _b:
+            return 0
+
+    def outer():
+        with _a:
+            return inner()
+
+    def other():
+        with _b:
+            with _a:
+                return 1
+    """
+    assert "lock-order-cycle" in _rules(src)
+
+
+def test_plain_lock_self_nesting_fires():
+    src = """
+    import threading
+
+    _a = threading.Lock()
+
+    def f():
+        with _a:
+            with _a:
+                return 1
+    """
+    assert "lock-order-cycle" in _rules(src)
+
+
+def test_rlock_self_nesting_is_fine():
+    src = """
+    import threading
+
+    _a = threading.RLock()
+
+    def f():
+        with _a:
+            with _a:
+                return 1
+    """
+    assert "lock-order-cycle" not in _rules(src)
+
+
+# ---------------------------------------------- device-sync-under-lock
+
+def test_sync_outside_lock_is_fine():
+    src = """
+    import threading
+    import numpy as np
+
+    _lock = threading.Lock()
+    _buf = []
+
+    def snapshot():
+        with _lock:
+            rows = list(_buf)
+        return np.asarray(rows)
+    """
+    assert "device-sync-under-lock" not in _rules(src)
+
+
+def test_sync_under_lock_outside_serving_obs_is_fine():
+    src = """
+    import threading
+    import numpy as np
+
+    _lock = threading.Lock()
+
+    def snapshot(x):
+        with _lock:
+            return np.asarray(x)
+    """
+    assert "device-sync-under-lock" not in _rules(
+        src, path="lightgbm_tpu/learners/mod.py")
+
+
+def test_block_until_ready_under_lock_fires():
+    src = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def wait(out):
+        with _lock:
+            out.block_until_ready()
+    """
+    assert "device-sync-under-lock" in _rules(src)
+
+
+# ------------------------------------------------- signal-unsafe-lock
+
+def test_signal_handler_rlock_is_fine():
+    src = """
+    import signal
+    import threading
+
+    _lock = threading.RLock()
+
+    def flush():
+        with _lock:
+            return 1
+
+    def _on_sigterm(signum, frame):
+        flush()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    """
+    assert "signal-unsafe-lock" not in _rules(src, path=RESILIENCE)
+
+
+def test_signal_unsafe_lock_crosses_modules():
+    # handler in resilience/ calls into an obs/ module that takes a
+    # plain Lock: the finding lands in the CALLED module
+    obs_src = textwrap.dedent("""
+    import threading
+
+    _lock = threading.Lock()
+
+    def flush():
+        with _lock:
+            return 1
+    """)
+    res_src = textwrap.dedent("""
+    import signal
+
+    from ..obs import sink
+
+    def _on_sigterm(signum, frame):
+        sink.flush()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    """)
+    findings = lint_concurrency_sources({
+        "lightgbm_tpu/obs/sink.py": obs_src,
+        "lightgbm_tpu/resilience/handler.py": res_src,
+    })
+    assert [f.rule for f in findings] == ["signal-unsafe-lock"]
+    assert findings[0].path == "lightgbm_tpu/obs/sink.py"
+
+
+def test_lockcheck_factories_classify_like_threading():
+    # the instrumented spellings must not blind the static pass
+    src = """
+    import signal
+
+    from ..analysis import lockcheck
+
+    _lock = lockcheck.make_lock("mod.lock")
+
+    def flush():
+        with _lock:
+            return 1
+
+    def _on_sigterm(signum, frame):
+        flush()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    """
+    assert "signal-unsafe-lock" in _rules(src, path=RESILIENCE)
+    assert "signal-unsafe-lock" not in _rules(
+        src.replace("make_lock", "make_rlock"), path=RESILIENCE)
+
+
+# -------------------------------------------------------- suppression
+
+_CYCLE_SRC = """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+def left():
+    with _a:
+        with _b:{line_pragma}
+            return 1
+
+def right():
+    with _b:
+        with _a:
+            return 2
+"""
+
+
+def test_line_pragma_suppresses_stage3():
+    dirty = textwrap.dedent(_CYCLE_SRC.format(line_pragma=""))
+    fs = lint_concurrency_source(dirty)
+    assert [f.rule for f in fs] == ["lock-order-cycle"]
+    # the pragma must sit on the exact line the finding anchors to
+    lines = dirty.splitlines()
+    lines[fs[0].line - 1] += "  # jaxlint: disable=lock-order-cycle"
+    assert lint_concurrency_source("\n".join(lines)) == []
+
+
+def test_file_pragma_suppresses_stage3():
+    dirty = textwrap.dedent(_CYCLE_SRC.format(line_pragma=""))
+    clean = "# jaxlint: disable-file=lock-order-cycle\n" + dirty
+    assert lint_concurrency_source(clean) == []
+
+
+def test_pragma_for_other_rule_does_not_suppress():
+    dirty = textwrap.dedent(_CYCLE_SRC.format(line_pragma=""))
+    fs = lint_concurrency_source(
+        "# jaxlint: disable-file=shared-state-unlocked\n" + dirty)
+    assert [f.rule for f in fs] == ["lock-order-cycle"]
+
+
+# ----------------------------------------------- known-bad fixture corpus
+
+FIXTURE_CASES = [
+    ("shared_state_unlocked.py", SERVING, "shared-state-unlocked"),
+    ("lock_order_cycle.py", SERVING, "lock-order-cycle"),
+    ("device_sync_under_lock.py", SERVING, "device-sync-under-lock"),
+    ("signal_unsafe_lock.py", RESILIENCE, "signal-unsafe-lock"),
+]
+
+
+def test_fixture_corpus_each_rule_exactly_once():
+    for fname, lint_path, rule in FIXTURE_CASES:
+        with open(os.path.join(FIXTURES, fname), encoding="utf-8") as fh:
+            src = fh.read()
+        fs = lint_concurrency_source(src, path=lint_path)
+        assert len(fs) == 1 and fs[0].rule == rule, (
+            fname, [str(f) for f in fs])

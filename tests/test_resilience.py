@@ -502,8 +502,8 @@ def test_chaos_dryrun_smoke():
     assert summary["failures"] == 0
     assert set(summary["results"]) == {
         "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
-        "serve_swap", "serve_fail_write", "desync", "straggler",
-        "oom_dispatch"}
+        "serve_swap", "serve_fail_write", "lockcheck_swap", "desync",
+        "straggler", "oom_dispatch"}
     # ISSUE 14: the preemption and refused-swap scenarios now also
     # assert a flight-recorder post-mortem (atomic + checksum sidecar,
     # tail = the triggering event) — pinned via the scenario details so
@@ -519,6 +519,14 @@ def test_chaos_dryrun_smoke():
         summary["results"]["desync"]["detail"]
     assert "attributed to rank 1" in \
         summary["results"]["straggler"]["detail"]
+    # ISSUE 18: the hot-swap-under-sanitizer scenario pins that the
+    # runtime lock checker was armed, saw real traffic, and stayed
+    # silent (a sanitizer that never observed an acquisition proves
+    # nothing, so the detail carries the acquisition count)
+    assert "zero sanitizer findings" in \
+        summary["results"]["lockcheck_swap"]["detail"]
+    assert "queue.cond acquisitions" in \
+        summary["results"]["lockcheck_swap"]["detail"]
     # ISSUE 16: the OOM post-mortem scenario pins tail = ``oom`` and
     # that the dump carries BOTH the live-buffer census (with owner
     # attribution) and the analytic memmodel prediction (obs/memory.py)

@@ -276,3 +276,39 @@ def test_leaf_counts_partition_rows():
     counts = np.bincount(leaf_id, minlength=nl)
     np.testing.assert_array_equal(counts[:nl], np.asarray(tree.leaf_count)[:nl])
     assert counts[nl:].sum() == 0
+
+
+# ---------------------------------------- float32 count-exactness envelope
+
+def test_count_envelope_boundary():
+    """leaf_count/internal_count ride the float32 count channel, which
+    is integer-exact only up to 2**24 (ADVICE r5): exactly 2**24 rows
+    is fine, one more must be rejected under hist_dtype=float32 and
+    accepted under float64."""
+    from lightgbm_tpu.learners.serial import (
+        F32_COUNT_EXACT_ROWS, check_count_envelope)
+
+    assert F32_COUNT_EXACT_ROWS == 2 ** 24
+    check_count_envelope(2 ** 24, "float32")  # boundary is inclusive
+    check_count_envelope(2 ** 24 + 1, "float64")  # f64 holds to 2**53
+    with pytest.raises(ValueError, match="float32 integer-exact"):
+        check_count_envelope(2 ** 24 + 1, "float32")
+
+
+def test_count_envelope_enforced_by_reset_training_data(monkeypatch):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io import BinnedDataset, Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4)
+    y = (rng.rand(64) > 0.5).astype(np.float32)
+    cfg = Config(objective="binary", num_leaves=4, hist_dtype="float32")
+    ds = BinnedDataset.from_matrix(X, Metadata(label=y), cfg)
+    # lie about the row count: the guard must fire before any
+    # allocation sized by n
+    monkeypatch.setattr(BinnedDataset, "num_data",
+                        property(lambda self: 2 ** 24 + 1))
+    with pytest.raises(ValueError, match="hist_dtype=float64"):
+        GBDT(cfg, ds, create_objective(cfg))

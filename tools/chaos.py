@@ -76,7 +76,7 @@ sys.path.insert(0, ROOT)
 
 SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
              "collective", "serve_swap", "serve_fail_write",
-             "desync", "straggler", "oom_dispatch")
+             "lockcheck_swap", "desync", "straggler", "oom_dispatch")
 
 
 def log(msg: str) -> None:
@@ -327,6 +327,101 @@ def scenario_serve_fail_write_inproc(tmp: str) -> str:
     assert not litter, f"partial result files leaked: {litter}"
     return ("pipelined writer failed before commit -> previous result "
             "intact, no partial files")
+
+
+_LOCKCHECK_DRIVER = r"""
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.getcwd())
+
+import numpy as np
+
+from lightgbm_tpu.analysis import lockcheck
+
+assert lockcheck.enabled(), "LGBM_TPU_LOCKCHECK=1 did not take"
+
+from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine, adopt_model
+
+m_a, m_b = sys.argv[1], sys.argv[2]
+engine = ServingEngine(m_a, buckets=(8, 32), max_batch_rows=32)
+X = np.random.RandomState(3).randn(16, 6)
+stop = threading.Event()
+errs = []
+q = MicroBatchQueue(engine, max_delay_s=0.001)
+
+
+def client():
+    try:
+        while not stop.is_set():
+            q.predict(X, timeout=60)
+    except Exception as e:
+        errs.append(f"{type(e).__name__}: {e}")
+
+
+threads = [threading.Thread(target=client) for _ in range(3)]
+for t in threads:
+    t.start()
+swaps = 0
+for i in range(6):
+    adopt_model(engine, m_b if i % 2 == 0 else m_a)
+    swaps += 1
+stop.set()
+for t in threads:
+    t.join(60)
+q.close()
+print(json.dumps({
+    "errors": errs,
+    "findings": lockcheck.findings(),
+    "swaps": swaps,
+    "acquisitions": {k: v["acquisitions"]
+                     for k, v in lockcheck.stats().items()},
+}))
+"""
+
+
+def scenario_lockcheck_swap_inproc(tmp: str, trees: int) -> str:
+    """Serving fault scenario 3: a hot-swap under client load with the
+    runtime lock sanitizer armed (LGBM_TPU_LOCKCHECK=1, fresh process
+    so every module-level lock is instrumented too) — the sanitizer
+    must stay silent (no lock-order inversion, no host sync while
+    holding a lock) while actually observing the traffic."""
+    data = os.path.join(tmp, "lockcheck_ds.csv")
+    make_data(data, 300, seed=13)
+    m_a = os.path.join(tmp, "lockcheck_a.txt")
+    m_b = os.path.join(tmp, "lockcheck_b.txt")
+    rc, _ = _run_inproc(train_args(data, m_a, trees) + ["verbose=-1"])
+    assert rc == 0, f"model A train rc={rc}"
+    rc, _ = _run_inproc(train_args(data, m_b, 2, [f"input_model={m_a}",
+                                                  "verbose=-1"]))
+    assert rc == 0, f"model B train rc={rc}"
+
+    driver = os.path.join(tmp, "lockcheck_driver.py")
+    with open(driver, "w", encoding="utf-8") as fh:
+        fh.write(_LOCKCHECK_DRIVER)
+    r = subprocess.run(
+        [sys.executable, driver, m_a, m_b],
+        capture_output=True, text=True, timeout=240, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "LGBM_TPU_LOCKCHECK": "1"},
+    )
+    assert r.returncode == 0, (
+        f"driver rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["errors"] == [], f"client errors: {out['errors']}"
+    assert out["findings"] == [], (
+        "sanitizer findings under hot-swap load: "
+        + json.dumps(out["findings"])[:2000])
+    acq = out["acquisitions"]
+    # the run must have actually exercised the instrumented locks —
+    # a silent sanitizer that never saw an acquisition proves nothing
+    assert acq.get("queue.cond", 0) > 0, acq
+    assert acq.get("engine.swap", 0) >= out["swaps"] > 0, acq
+    return (f"hot-swap under LGBM_TPU_LOCKCHECK=1: {out['swaps']} swaps, "
+            f"{acq['queue.cond']} queue.cond acquisitions, zero "
+            "sanitizer findings")
 
 
 def scenario_desync_inproc(tmp: str) -> str:
@@ -628,6 +723,7 @@ def main() -> int:
         run("collective", scenario_collective_inproc, tmp)
         run("serve_swap", scenario_serve_swap_inproc, tmp, 4)
         run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
+        run("lockcheck_swap", scenario_lockcheck_swap_inproc, tmp, 4)
         run("desync", scenario_desync_inproc, tmp)
         run("straggler", scenario_straggler_inproc, tmp)
         run("oom_dispatch", scenario_oom_dispatch_inproc, tmp)
@@ -643,6 +739,10 @@ def main() -> int:
         # surface (checksum verify, atomic commit) is process-local
         run("serve_swap", scenario_serve_swap_inproc, tmp, 4)
         run("serve_fail_write", scenario_serve_fail_write_inproc, tmp)
+        # the sanitizer scenario is its own subprocess in both modes:
+        # the env knob must be set before import so module-level locks
+        # are instrumented too
+        run("lockcheck_swap", scenario_lockcheck_swap_inproc, tmp, 4)
         # the distributed scenarios simulate their worlds in-process in
         # both modes (the REAL multi-process versions live behind the
         # env-gated tests/test_multihost.py aggregation tests — this

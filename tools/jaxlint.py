@@ -2,12 +2,14 @@
 """jaxlint CLI: JAX-aware lint + compiled-artifact audit gate.
 
 Usage:
-    python tools/jaxlint.py                  # both stages over lightgbm_tpu/
+    python tools/jaxlint.py                  # all 3 stages over lightgbm_tpu/
     python tools/jaxlint.py --ast-only path/to/file.py
     python tools/jaxlint.py --artifacts-only # stage 2 (CPU trace/compile)
+    python tools/jaxlint.py --concurrency-only  # stage 3 (lock discipline)
     python tools/jaxlint.py --list-rules
 
-Exit status 0 = clean, 1 = findings, 2 = audit machinery error.
+Exit status 0 = clean, 1 = findings (from ANY stage), 2 = audit
+machinery error.
 
 Writes ``COPYCHECK.json`` (schema: {"threshold", "flagged", "error"},
 the pre-existing artifact contract) with each finding as
@@ -32,9 +34,11 @@ def main() -> int:
                     help="files/dirs for the AST stage "
                          "(default: lightgbm_tpu/)")
     ap.add_argument("--ast-only", action="store_true",
-                    help="skip the compiled-artifact audit")
+                    help="stage 1 only (pure-AST lint)")
     ap.add_argument("--artifacts-only", action="store_true",
-                    help="skip the AST lint")
+                    help="stage 2 only (compiled-artifact audit)")
+    ap.add_argument("--concurrency-only", action="store_true",
+                    help="stage 3 only (lock-discipline lint)")
     ap.add_argument("--json", default=None,
                     help="machine-readable output path ('' disables; "
                          "default: the repo COPYCHECK.json for FULL "
@@ -44,15 +48,26 @@ def main() -> int:
     args = ap.parse_args()
 
     from lightgbm_tpu.analysis import (
-        ARTIFACT_RULES, AST_RULES, audit_artifacts, lint_paths)
+        ARTIFACT_RULES, AST_RULES, CONCURRENCY_RULES, audit_artifacts,
+        lint_concurrency_paths, lint_paths)
 
     if args.list_rules:
-        for rid, desc in {**AST_RULES, **ARTIFACT_RULES}.items():
+        for rid, desc in {**AST_RULES, **ARTIFACT_RULES,
+                          **CONCURRENCY_RULES}.items():
             print(f"{rid}\n    {desc}")
         return 0
 
+    only_flags = (args.ast_only, args.artifacts_only,
+                  args.concurrency_only)
+    if sum(only_flags) > 1:
+        ap.error("--ast-only/--artifacts-only/--concurrency-only "
+                 "are mutually exclusive")
+    run_ast = not (args.artifacts_only or args.concurrency_only)
+    run_artifacts = not (args.ast_only or args.concurrency_only)
+    run_concurrency = not (args.ast_only or args.artifacts_only)
+
     if args.json is None:
-        full_run = not (args.ast_only or args.artifacts_only or args.paths)
+        full_run = not (any(only_flags) or args.paths)
         args.json = (os.path.join(ROOT, "COPYCHECK.json") if full_run
                      else "")
 
@@ -60,11 +75,13 @@ def main() -> int:
     measured = {}
     error = ""
 
-    if not args.artifacts_only:
-        paths = args.paths or [os.path.join(ROOT, "lightgbm_tpu")]
+    paths = args.paths or [os.path.join(ROOT, "lightgbm_tpu")]
+    if run_ast:
         findings.extend(lint_paths(paths))
+    if run_concurrency:
+        findings.extend(lint_concurrency_paths(paths))
 
-    if not args.ast_only:
+    if run_artifacts:
         # the artifact audit traces/compiles on CPU whatever the outer
         # environment points at: budgets are CPU-backend numbers, and a
         # dead TPU tunnel must not hang lint.  FORCE the platform (the
