@@ -449,16 +449,31 @@ def run_predict(cfg: Config) -> None:
         Log.debug("predict pipeline " + json.dumps(stats, sort_keys=True))
 
 
-def run_serve(cfg: Config) -> None:
+def run_serve(cfg: Config) -> int:
     """``task=serve``: the online micro-batched inference service
     (serving/server.py; docs/serving.md) — a persistent on-device
     ensemble behind shape-bucketed dispatch with checksum-verified
-    hot-swap, serving until SIGINT/SIGTERM."""
+    hot-swap, serving until SIGINT/SIGTERM, then draining gracefully
+    and exiting 75 (the supervisor-relaunch contract)."""
     from .serving import serve_from_config
 
     if not cfg.input_model:
         Log.fatal("input_model should not be empty for serve task")
-    serve_from_config(cfg, block=True)
+    return int(serve_from_config(cfg, block=True) or 0)
+
+
+def run_serve_fleet(cfg: Config) -> int:
+    """``task=serve_fleet``: the replica supervisor
+    (serving/supervisor.py; docs/serving.md) — N ``task=serve``
+    subprocesses behind one round-robin front end, health-checked,
+    restarted on crash/preemption with jittered backoff, scaled between
+    ``serve_replicas`` and ``serve_max_replicas`` off the queue-depth
+    gauge."""
+    from .serving.supervisor import serve_fleet_from_config
+
+    if not cfg.input_model:
+        Log.fatal("input_model should not be empty for serve_fleet task")
+    return int(serve_fleet_from_config(cfg) or 0)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -485,7 +500,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif cfg.task in ("predict", "prediction", "test"):
             run_predict(cfg)
         elif cfg.task == "serve":
-            run_serve(cfg)
+            return run_serve(cfg)
+        elif cfg.task == "serve_fleet":
+            return run_serve_fleet(cfg)
         else:
             Log.fatal(f"Unknown task: {cfg.task!r}")
     except TrainingPreempted as ex:

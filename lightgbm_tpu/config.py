@@ -312,6 +312,23 @@ class Config:
     # require a .sha256 sidecar on the model loaded at serve startup
     # (hot-swap candidates ALWAYS require one; see docs/serving.md)
     serve_require_checksum: bool = False
+    # admission control: rows admitted to the micro-batch queue at once
+    # (0 = unbounded); an overflowing submit is shed with HTTP 429 and
+    # a Retry-After hint instead of growing the backlog until every
+    # request times out (docs/serving.md overload contract)
+    serve_max_queue_rows: int = 8192
+    # when set, the serve task writes {url, pid, model_id} here (atomic)
+    # once the server is listening — the supervisor's readiness signal
+    serve_ready_file: str = ""
+
+    # ---- serving fleet (task=serve_fleet; serving/supervisor.py)
+    # replica subprocesses at fleet start; also the scale-down floor
+    serve_replicas: int = 2
+    # autoscale ceiling off the queue-depth gauge; 0 = no autoscaling
+    serve_max_replicas: int = 0
+    # total replica restarts the supervisor performs (with jittered
+    # exponential backoff) before failing the whole fleet loudly
+    serve_restart_budget: int = 8
 
     def __post_init__(self):
         if not self.metric:
@@ -434,6 +451,17 @@ class Config:
             raise ValueError("serve_max_batch_rows must be >= 1")
         if self.serve_max_delay_ms < 0:
             raise ValueError("serve_max_delay_ms must be >= 0")
+        if self.serve_max_queue_rows < 0:
+            raise ValueError(
+                "serve_max_queue_rows must be >= 0 (0 = unbounded)")
+        if self.serve_replicas < 1:
+            raise ValueError("serve_replicas must be >= 1")
+        if self.serve_max_replicas and \
+                self.serve_max_replicas < self.serve_replicas:
+            raise ValueError(
+                "serve_max_replicas must be 0 (off) or >= serve_replicas")
+        if self.serve_restart_budget < 0:
+            raise ValueError("serve_restart_budget must be >= 0")
         if not 0.0 <= self.skip_drop <= 1.0:
             raise ValueError("skip_drop must be in [0, 1]")
 

@@ -17,6 +17,10 @@ into a *service*:
 * :mod:`batch`   — the batch tier: overlapped parse -> predict -> write
   file prediction (byte-identical to the sequential path, crash-safe
   via ``atomic_writer``).
+* :mod:`supervisor` — the fleet layer (``task=serve_fleet``): N
+  supervised replica subprocesses, health-checked restarts with
+  jittered backoff and a hard budget, round-robin routing with one
+  bounded retry on a different replica, queue-depth autoscaling.
 
 See docs/serving.md for the architecture, the bucketing policy, the
 hot-swap contract, and the fault matrix.
@@ -26,15 +30,24 @@ from .batch import (format_block, pipelined_predict_file,
                     predict_chunk_stream)
 from .engine import PackedModel, ServingEngine, power_of_two_buckets
 from .hotswap import adopt_model, load_packed_model
-from .queue import MicroBatchQueue, PredictionResult
+from .queue import (DeadlineExpired, MicroBatchQueue, PredictionResult,
+                    QueueDraining, QueueFull, RequestShed)
 from .server import (InProcessClient, ServingServer, serve_from_config,
                      write_serving_manifest)
+from .supervisor import (FleetBudgetExhausted, FleetFrontEnd,
+                         FleetRequestFailed, ReplicaSupervisor,
+                         SubprocessReplica, ThreadReplica,
+                         serve_fleet_from_config)
 
 __all__ = [
     "format_block", "pipelined_predict_file", "predict_chunk_stream",
     "PackedModel", "ServingEngine", "power_of_two_buckets",
     "adopt_model", "load_packed_model",
     "MicroBatchQueue", "PredictionResult",
+    "RequestShed", "QueueFull", "DeadlineExpired", "QueueDraining",
     "InProcessClient", "ServingServer", "serve_from_config",
     "write_serving_manifest",
+    "ReplicaSupervisor", "SubprocessReplica", "ThreadReplica",
+    "FleetFrontEnd", "FleetRequestFailed", "FleetBudgetExhausted",
+    "serve_fleet_from_config",
 ]

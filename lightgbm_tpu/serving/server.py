@@ -10,21 +10,34 @@ the transport itself.
 Endpoints (JSON in/out unless noted):
 
 =======================  ====================================================
-``POST /v1/predict``     ``{"rows": [[...], ...], "raw_score": false}`` ->
+``POST /v1/predict``     ``{"rows": [[...], ...], "raw_score": false,
+                         "deadline_ms": 50, "priority": "interactive"}`` ->
                          ``{"predictions": [...], "model_id": ..., "n": N,
                          "trace_id": ..., "stages": {queue_wait_s, pad_s,
                          device_s, scatter_s}}``.  An inbound
                          ``X-LGBM-Trace-Id`` header is honored (adopted as
                          the trace id) and echoed on the response; without
-                         one, a fresh id is minted and still echoed.
+                         one, a fresh id is minted and still echoed.  An
+                         ``X-LGBM-Deadline-Ms`` header sets the request
+                         deadline (body ``deadline_ms`` wins when both are
+                         present).  Admission-control sheds map to
+                         429 (queue full/evicted), 503 (draining) and 504
+                         (deadline expired in-queue), each carrying
+                         ``{"error", "reason", "retry_after_s"}`` plus a
+                         ``Retry-After`` header when retrying can help
+                         (docs/serving.md retryability table).
 ``POST /v1/swap``        ``{"model": "/path/to/model.txt"}`` -> swap summary;
                          409 + error on a corrupt/unverifiable candidate
                          (the old model keeps serving)
 ``GET  /v1/healthz``     readiness payload: engine identity (model_id),
                          seconds since the last model (s)wap, bucket
-                         ladder, queue depth — enough for a load balancer
-                         to drain a replica mid-swap.  Contract unchanged
-                         from the liveness days: 200 whenever alive.
+                         ladder, plus the queue-pressure fields the
+                         supervisor and autoscalers share (``state:
+                         serving|draining``, ``queue_depth``,
+                         ``queue_rows``, ``shed_last_60s``).  200 while
+                         serving; 503 once draining (SIGTERM landed) so
+                         load balancers stop routing here while in-flight
+                         work finishes.
 ``GET  /v1/stats``       full telemetry snapshot (serving reservoirs incl.
                          request p50/p99, stage breakdowns, batch
                          occupancy, queue depth)
@@ -37,6 +50,7 @@ Endpoints (JSON in/out unless noted):
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -50,9 +64,18 @@ from ..obs import export as metrics_export
 from ..obs import memory as obs_memory
 from ..resilience.atomic import ArtifactCorrupt
 from .engine import ServingEngine
-from .queue import MicroBatchQueue
+from .queue import MicroBatchQueue, RequestShed
 
 _PREDICT_TIMEOUT_S = 120.0
+
+
+def _shed_payload(e: RequestShed) -> Tuple[int, dict]:
+    """One mapping from a typed shed to its wire shape — every
+    transport (HTTP, in-process, supervisor) sees the same contract."""
+    out = {"error": str(e), "reason": e.reason}
+    if e.http_status in (429, 503):  # retrying elsewhere/later helps
+        out["retry_after_s"] = round(float(e.retry_after_s), 3)
+    return e.http_status, out
 
 
 # ------------------------------------------------------------- handlers
@@ -72,7 +95,8 @@ def _result_payload(values, model_id: str, trace_id: str = "",
 
 def api_predict(engine: ServingEngine, queue: MicroBatchQueue,
                 payload: dict,
-                trace_id: Optional[str] = None) -> Tuple[int, dict]:
+                trace_id: Optional[str] = None,
+                deadline_ms: Optional[float] = None) -> Tuple[int, dict]:
     rows = payload.get("rows")
     if rows is None:
         return 400, {"error": "missing 'rows'"}
@@ -80,6 +104,20 @@ def api_predict(engine: ServingEngine, queue: MicroBatchQueue,
         X = np.asarray(rows, dtype=np.float64)
     except (TypeError, ValueError) as e:
         return 400, {"error": f"rows not numeric: {e}"}
+    if payload.get("deadline_ms") is not None:
+        try:
+            deadline_ms = float(payload["deadline_ms"])
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad deadline_ms: {e}"}
+    priority = str(payload.get("priority") or "interactive")
+    if queue.state == "draining":
+        # one refusal for BOTH paths: the engine-direct branch below
+        # bypasses the queue, but a draining replica admits nothing
+        from .queue import QueueDraining
+
+        telemetry.count("serving.shed.draining")
+        return _shed_payload(QueueDraining(
+            "replica is draining; retry on another replica"))
     raw = bool(payload.get("raw_score", False))
     if raw != queue._raw_score:
         # the queue batches homogeneous work; per-request raw_score
@@ -113,7 +151,10 @@ def api_predict(engine: ServingEngine, queue: MicroBatchQueue,
             stages=trace.stages if trace is not None else None)
     try:
         res = queue.predict(X, timeout=_PREDICT_TIMEOUT_S,
-                            trace_id=trace_id)
+                            trace_id=trace_id, deadline_ms=deadline_ms,
+                            priority=priority)
+    except RequestShed as e:
+        return _shed_payload(e)
     except ValueError as e:
         return 400, {"error": str(e)}
     return 200, _result_payload(res.values, res.model_id,
@@ -139,14 +180,22 @@ def api_swap(engine: ServingEngine, payload: dict,
 
 def api_health(engine: ServingEngine,
                queue: MicroBatchQueue) -> Tuple[int, dict]:
-    """Readiness payload (the old liveness contract — 200 whenever the
-    process is alive — still holds; the body just got useful): which
-    model is serving, how long since it was (s)wapped in, the bucket
-    ladder, and the queue depth, so a load balancer can drain a replica
-    that is mid-swap or backlogged instead of blindly routing to it."""
-    return 200, {"status": "ok", "queue_depth": queue.depth,
-                 "last_swap_age_s": round(engine.last_swap_age_s, 3),
-                 **engine.describe()}
+    """Readiness payload: which model is serving, how long since it was
+    (s)wapped in, the bucket ladder, and the queue-pressure fields the
+    supervisor and autoscalers share (``state``, ``queue_depth``,
+    ``queue_rows``, ``shed_last_60s``).  200 while serving; 503 once
+    the replica is draining (the readiness flip load balancers key on —
+    in-flight work still finishes behind it)."""
+    state = queue.state
+    return (200 if state == "serving" else 503), {
+        "status": "ok" if state == "serving" else "draining",
+        "state": state,
+        "queue_depth": queue.depth,
+        "queue_rows": queue.pending_rows,
+        "max_queue_rows": queue.max_queue_rows,
+        "shed_last_60s": queue.shed_last_60s,
+        "last_swap_age_s": round(engine.last_swap_age_s, 3),
+        **engine.describe()}
 
 
 def api_stats() -> Tuple[int, dict]:
@@ -168,6 +217,20 @@ def api_metrics(engine: ServingEngine,
             engine.max_batch_rows, "largest serving bucket (rows)"),
         "lgbm_serving_bucket_count": (
             len(engine.buckets), "size of the padded-shape bucket ladder"),
+        # fleet/overload pressure gauges (ISSUE 19; docs/serving.md):
+        # STABLE names — the supervisor and dashboards key on them
+        "lgbm_serving_state": (
+            1 if queue.state == "serving" else 0,
+            "1 = serving (admitting), 0 = draining"),
+        "lgbm_serving_queue_rows_pending": (
+            queue.pending_rows,
+            "rows admitted and waiting (bounded by max_queue_rows)"),
+        "lgbm_serving_max_queue_rows": (
+            queue.max_queue_rows,
+            "admission bound in rows (0 = unbounded)"),
+        "lgbm_serving_shed_last_60s": (
+            queue.shed_last_60s,
+            "requests shed in the last 60 seconds (any reason)"),
     }
     # device-memory gauges (obs/memory.py): allocator stats + the
     # owner-tagged live-buffer census, fresh per scrape
@@ -192,10 +255,13 @@ class InProcessClient:
         self.require_checksum = require_checksum
 
     def predict(self, rows, raw_score: bool = False,
-                trace_id: Optional[str] = None) -> Tuple[int, dict]:
+                trace_id: Optional[str] = None,
+                deadline_ms: Optional[float] = None,
+                priority: str = "interactive") -> Tuple[int, dict]:
         return api_predict(self.engine, self.queue,
-                           {"rows": rows, "raw_score": raw_score},
-                           trace_id=trace_id)
+                           {"rows": rows, "raw_score": raw_score,
+                            "priority": priority},
+                           trace_id=trace_id, deadline_ms=deadline_ms)
 
     def swap(self, model_path: str) -> Tuple[int, dict]:
         return api_swap(self.engine, {"model": model_path},
@@ -280,13 +346,29 @@ class _Handler(BaseHTTPRequestHandler):
                 # minted downstream) and echo whatever id the request
                 # ended up carrying, so the caller can correlate
                 header_tid = self.headers.get("X-LGBM-Trace-Id")
+                deadline_ms = None
+                hdr_deadline = self.headers.get("X-LGBM-Deadline-Ms")
+                if hdr_deadline:
+                    try:
+                        deadline_ms = float(hdr_deadline)
+                    except ValueError:
+                        self._send(400, {"error": "bad X-LGBM-Deadline-Ms "
+                                                  f"header: {hdr_deadline!r}"})
+                        return
                 code, out = api_predict(self.server.engine,
                                         self.server.queue, payload,
-                                        trace_id=header_tid)
+                                        trace_id=header_tid,
+                                        deadline_ms=deadline_ms)
+                extra = {}
                 echo = out.get("trace_id")
-                self._send(code, out,
-                           extra_headers={"X-LGBM-Trace-Id": echo}
-                           if echo else None)
+                if echo:
+                    extra["X-LGBM-Trace-Id"] = echo
+                if out.get("retry_after_s") is not None:
+                    # HTTP Retry-After is integer delay-seconds; never
+                    # round a positive hint down to "retry immediately"
+                    extra["Retry-After"] = str(
+                        max(1, math.ceil(float(out["retry_after_s"]))))
+                self._send(code, out, extra_headers=extra or None)
             elif self.path == "/v1/swap":
                 self._send(*api_swap(
                     self.server.engine, payload,
@@ -352,11 +434,16 @@ def write_serving_manifest(engine: ServingEngine, path: str,
     return manifest.write(path)
 
 
-def serve_from_config(cfg, block: bool = True) -> Optional[ServingServer]:
+def serve_from_config(cfg, block: bool = True):
     """``task=serve`` entry (cli.py): build the serving stack from a
     Config and run it.  ``block=False`` returns the started server (the
     tier-1 path); ``block=True`` serves until SIGINT/SIGTERM, then
-    writes the serving manifest next to the model."""
+    DRAINS — healthz flips to ``draining`` (503), admission closes,
+    every admitted request finishes, the flight recorder dumps
+    (``reason="drain"``) and the serving manifest is written — and
+    returns :data:`~lightgbm_tpu.resilience.EXIT_PREEMPTED` (75), the
+    same contract a preempted training run exits with, so one
+    supervisor relaunch policy covers both tiers."""
     if not cfg.input_model:
         raise ValueError("input_model should not be empty for serve task")
     import os
@@ -376,17 +463,22 @@ def serve_from_config(cfg, block: bool = True) -> Optional[ServingServer]:
     engine = ServingEngine(pm, buckets=buckets,
                            max_batch_rows=cfg.serve_max_batch_rows)
     queue = MicroBatchQueue(engine,
-                            max_delay_s=cfg.serve_max_delay_ms / 1000.0)
+                            max_delay_s=cfg.serve_max_delay_ms / 1000.0,
+                            max_queue_rows=cfg.serve_max_queue_rows)
     server = ServingServer(engine, queue, host=cfg.serve_host,
                            port=cfg.serve_port)
     Log.info(
         f"serving model {engine.model_id[:12]} ({pm.num_trees} trees) "
         f"at {server.url} — buckets {list(engine.buckets)}, "
-        f"max_delay {cfg.serve_max_delay_ms}ms")
+        f"max_delay {cfg.serve_max_delay_ms}ms, "
+        f"max_queue_rows {cfg.serve_max_queue_rows}")
     if not block:
         return server.start()
 
     import signal
+
+    from ..resilience import EXIT_PREEMPTED
+    from ..resilience.atomic import atomic_write_json
 
     stop = threading.Event()
 
@@ -397,11 +489,28 @@ def serve_from_config(cfg, block: bool = True) -> Optional[ServingServer]:
     old_term = signal.signal(signal.SIGTERM, _stop)
     old_int = signal.signal(signal.SIGINT, _stop)
     server.start()
+    if cfg.serve_ready_file:
+        # the supervisor's readiness signal: atomic, so a reader never
+        # sees half a JSON (serving/supervisor.py polls this)
+        atomic_write_json(cfg.serve_ready_file,
+                          {"url": server.url, "pid": os.getpid(),
+                           "model_id": engine.model_id})
     try:
         stop.wait()
     finally:
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
+        # drain order matters: admission closes FIRST (healthz answers
+        # 503/draining from here on), every admitted request finishes,
+        # and only then does the HTTP listener go down — a kill window
+        # where accepted work is silently dropped must not exist
+        depth_at_signal = queue.depth
+        queue.begin_drain()
+        queue.drain()
+        flightrec.record("drain", state=queue.state,
+                         queue_depth_at_signal=depth_at_signal,
+                         shed_last_60s=queue.shed_last_60s)
+        flightrec.dump(reason="drain")
         server.close()
         try:
             mpath = cfg.input_model + ".serving.manifest.json"
@@ -409,4 +518,6 @@ def serve_from_config(cfg, block: bool = True) -> Optional[ServingServer]:
             Log.info(f"Wrote serving manifest to {mpath}")
         except Exception as e:  # noqa: BLE001 — best-effort evidence
             Log.warning(f"serving manifest write failed: {e}")
-    return None
+        Log.info("serving: drained; exiting 75 (EX_TEMPFAIL) for the "
+                 "supervisor")
+    return EXIT_PREEMPTED

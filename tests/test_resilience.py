@@ -503,7 +503,8 @@ def test_chaos_dryrun_smoke():
     assert set(summary["results"]) == {
         "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
         "serve_swap", "serve_fail_write", "lockcheck_swap", "desync",
-        "straggler", "oom_dispatch"}
+        "straggler", "oom_dispatch", "overload_shed", "serve_drain",
+        "replica_kill", "lockcheck_fleet"}
     # ISSUE 14: the preemption and refused-swap scenarios now also
     # assert a flight-recorder post-mortem (atomic + checksum sidecar,
     # tail = the triggering event) — pinned via the scenario details so
@@ -536,6 +537,24 @@ def test_chaos_dryrun_smoke():
         summary["results"]["oom_dispatch"]["detail"]
     assert "memmodel predicted peak" in \
         summary["results"]["oom_dispatch"]["detail"]
+    # ISSUE 19: the fleet scenarios pin zero-loss across a replica kill
+    # under live load, the bounded queue holding its row bound with
+    # honest shed mappings, the drain refusing new work while finishing
+    # admitted work, and the fleet layer staying silent under the
+    # runtime lock sanitizer WHILE its locks saw real traffic
+    assert "ZERO failed" in summary["results"]["replica_kill"]["detail"]
+    assert "victim restarted" in \
+        summary["results"]["replica_kill"]["detail"]
+    assert "429 + Retry-After" in \
+        summary["results"]["overload_shed"]["detail"]
+    assert "dispatcher alive" in \
+        summary["results"]["overload_shed"]["detail"]
+    assert "admitted work finished bitwise" in \
+        summary["results"]["serve_drain"]["detail"]
+    assert "zero sanitizer findings" in \
+        summary["results"]["lockcheck_fleet"]["detail"]
+    assert "supervisor.state acquisitions" in \
+        summary["results"]["lockcheck_fleet"]["detail"]
 
 
 @pytest.mark.slow
@@ -552,3 +571,23 @@ def test_chaos_subprocess_random_kill():
     )
     assert r.returncode == 0, (
         f"seed={seed}\n" + r.stdout[-3000:] + r.stderr[-2000:])
+
+
+@pytest.mark.slow
+def test_chaos_subprocess_fleet_kill_and_drain():
+    """The real fleet faults: SIGKILL one replica SUBPROCESS of a
+    supervised fleet under live load (zero requests may fail), and
+    SIGTERM a live task=serve process (drain, exit 75, flightrec
+    dump)."""
+    for scenario, pin in (("replica_kill", "ZERO failed"),
+                          ("serve_drain", "exit 75")):
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--scenario", scenario],
+            capture_output=True, text=True, timeout=600, cwd=ROOT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        summary = json.loads(r.stdout.strip().splitlines()[-1])
+        assert summary["failures"] == 0
+        assert pin in summary["results"][scenario]["detail"]

@@ -1,0 +1,488 @@
+"""Tier-1 gate for serving-fleet resilience (ISSUE 19):
+
+* admission control — a deadline that expires in-queue is NEVER
+  dispatched (504), the bounded queue refuses with 429 + Retry-After,
+  an interactive arrival evicts queued batch work (shed-lowest-first),
+  and interactive dispatches ahead of batch within one assembly;
+* graceful drain — admission closes with a typed 503 while every
+  admitted request still finishes, bitwise;
+* the replica supervisor — round-robin routing with ONE bounded retry
+  on a different replica for 503/transport (idempotent by
+  construction), jittered exponential backoff on restart, a restart
+  budget that fails the fleet LOUDLY when exhausted, and the pure
+  ``scale_decision`` policy;
+* ``tools/benchdiff.py``'s fleet kind — failed>0 / leaked bound /
+  accepted-p99 blowup / shed-rate growth at flat load regress (exit 1),
+  shed growth under HIGHER offered load only warns, and fleet
+  artifacts never diff against any other kind (exit 2, both ways).
+
+Everything here runs against fake engines / fake replica transports —
+no model training, no subprocesses (tools/chaos.py owns the
+end-to-end kill/drain runs), so the module stays cheap in tier-1.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from lightgbm_tpu.obs import flightrec, telemetry  # noqa: E402
+from lightgbm_tpu.serving import (DeadlineExpired, FleetBudgetExhausted,  # noqa: E402
+                                  FleetFrontEnd, MicroBatchQueue,
+                                  QueueDraining, QueueFull,
+                                  ReplicaSupervisor)
+from lightgbm_tpu.serving import supervisor as supervisor_mod  # noqa: E402
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _counters():
+    return dict(telemetry.get_telemetry().snapshot()["counters"])
+
+
+# ------------------------------------------------------------ fake engine
+class FakeEngine:
+    """Deterministic stand-in for ServingEngine: output is
+    ``3 * X[:, 0]`` so scatter order and bitwise delivery are checkable
+    without a model; an optional gate blocks dispatch so tests can
+    build queue pressure deterministically."""
+
+    max_batch_rows = 16
+    num_features = 4
+    model_id = "fake-model"
+
+    def __init__(self, gate=None):
+        self.gate = gate
+        self.batches = []  # first-column values of each dispatched batch
+
+    def predict_with_meta(self, X, raw_score=False, clock=None):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never opened"
+        self.batches.append(np.asarray(X)[:, 0].copy())
+        return np.asarray(X)[:, 0].astype(np.float64) * 3.0, self.model_id
+
+
+def _rows(n, value):
+    X = np.full((n, FakeEngine.num_features), float(value),
+                dtype=np.float32)
+    return X
+
+
+def _occupy(q, gate):
+    """Park the dispatcher inside the (gated) engine so everything
+    submitted afterwards stays queued until the gate opens."""
+    fut = q.submit(_rows(1, 0.0))
+    deadline = time.monotonic() + 5.0
+    while q.pending_rows > 0:  # taken by the dispatcher -> now in-engine
+        assert time.monotonic() < deadline, "dispatcher never took bait"
+        time.sleep(0.002)
+    return fut
+
+
+def test_deadline_expiry_sheds_in_queue_never_dispatched():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    q = MicroBatchQueue(eng, max_delay_s=0.005)
+    before = _counters()
+    occupier = _occupy(q, gate)
+    doomed = q.submit(_rows(2, 7.0), deadline_ms=20.0)
+    time.sleep(0.05)  # expire while the dispatcher is stuck in-engine
+    gate.set()
+    with pytest.raises(DeadlineExpired) as ei:
+        doomed.result(timeout=10.0)
+    assert ei.value.http_status == 504
+    assert ei.value.reason == "deadline"
+    assert "never dispatched" in str(ei.value)
+    occupier.result(timeout=10.0)
+    q.close()
+    # the doomed rows (value 7.0) must not appear in ANY dispatched batch
+    assert not any((b == 7.0).any() for b in eng.batches)
+    after = _counters()
+    assert after.get("serving.shed.deadline", 0) \
+        >= before.get("serving.shed.deadline", 0) + 1
+
+
+def test_bounded_queue_refuses_with_429_and_retry_after():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    q = MicroBatchQueue(eng, max_delay_s=0.005, max_queue_rows=8)
+    occupier = _occupy(q, gate)
+    admitted = [q.submit(_rows(4, 1.0), priority="batch"),
+                q.submit(_rows(4, 2.0), priority="batch")]
+    # bound reached: a batch arrival is refused outright
+    with pytest.raises(QueueFull) as ei:
+        q.submit(_rows(4, 3.0), priority="batch")
+    assert ei.value.http_status == 429
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s > 0
+    # ... but an interactive arrival evicts queued batch work
+    # (shed-lowest-first, newest victim first: the 2.0 batch)
+    vip = q.submit(_rows(4, 9.0), priority="interactive")
+    with pytest.raises(QueueFull) as ei:
+        admitted[1].result(timeout=10.0)
+    assert ei.value.reason == "evicted"
+    gate.set()
+    occupier.result(timeout=10.0)
+    res = vip.result(timeout=10.0)
+    np.testing.assert_array_equal(res.values, np.full(4, 27.0))
+    first = admitted[0].result(timeout=10.0)
+    np.testing.assert_array_equal(first.values, np.full(4, 3.0))
+    q.close()
+    # the evicted rows (value 2.0) were never dispatched
+    assert not any((b == 2.0).any() for b in eng.batches)
+
+
+def test_interactive_dispatches_ahead_of_batch():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    q = MicroBatchQueue(eng, max_delay_s=0.005)
+    occupier = _occupy(q, gate)
+    lo = q.submit(_rows(2, 1.0), priority="batch")
+    hi = q.submit(_rows(2, 2.0), priority="interactive")
+    gate.set()
+    occupier.result(timeout=10.0)
+    lo.result(timeout=10.0)
+    hi.result(timeout=10.0)
+    q.close()
+    mixed = [b for b in eng.batches if (b == 1.0).any() and (b == 2.0).any()]
+    if mixed:  # coalesced: interactive rows lead the assembled batch
+        b = mixed[0]
+        assert list(b) == [2.0, 2.0, 1.0, 1.0]
+    else:  # dispatched separately: interactive batch went first
+        order = [b[0] for b in eng.batches if b[0] in (1.0, 2.0)]
+        assert order == [2.0, 1.0]
+
+
+def test_drain_finishes_admitted_work_bitwise_and_refuses_new():
+    gate = threading.Event()
+    eng = FakeEngine(gate=gate)
+    q = MicroBatchQueue(eng, max_delay_s=0.005)
+    occupier = _occupy(q, gate)
+    inflight = q.submit(_rows(3, 5.0))
+    q.begin_drain()
+    assert q.state == "draining"
+    with pytest.raises(QueueDraining) as ei:
+        q.submit(_rows(1, 1.0))
+    assert ei.value.http_status == 503
+    assert ei.value.reason == "draining"
+    gate.set()
+    res = inflight.result(timeout=10.0)
+    np.testing.assert_array_equal(res.values, np.full(3, 15.0))
+    occupier.result(timeout=10.0)
+    q.drain()
+    assert q.depth == 0
+    assert not q.dispatcher_alive
+
+
+# ------------------------------------------------------- fake replica fleet
+class FakeHandle:
+    """Replica handle double: the supervisor only touches url / start /
+    wait_ready / exit_code / kill / terminate."""
+
+    def __init__(self, url):
+        self.url = url
+        self.pid = 0
+        self.rc = None
+        self.terminated = False
+
+    def start(self):
+        return self
+
+    def wait_ready(self, timeout=0.0):
+        return None
+
+    def exit_code(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def terminate(self, timeout=0.0):
+        self.terminated = True
+        self.rc = 75
+        return self.rc
+
+
+class FakeTransport:
+    """In-process stand-in for supervisor._http_json: routes by URL
+    prefix, records every attempt, raises OSError for urls marked
+    down."""
+
+    def __init__(self):
+        self.responses = {}  # url prefix -> (status, body) or OSError
+        self.calls = []      # (method, url, payload)
+
+    def __call__(self, method, url, payload=None, headers=None,
+                 timeout=30.0):
+        self.calls.append((method, url, payload))
+        for prefix, resp in self.responses.items():
+            if url.startswith(prefix):
+                if isinstance(resp, Exception):
+                    raise resp
+                return resp
+        raise OSError(f"no fake route for {url}")
+
+    def predicts_to(self, prefix):
+        return [c for c in self.calls
+                if c[0] == "POST" and c[1].startswith(prefix)]
+
+
+@pytest.fixture()
+def fake_fleet(monkeypatch):
+    transport = FakeTransport()
+    monkeypatch.setattr(supervisor_mod, "_http_json", transport)
+    made = []
+
+    def factory(slot_id):
+        h = FakeHandle(f"http://replica-{slot_id}-gen{len(made)}")
+        made.append(h)
+        return h
+
+    return transport, factory, made
+
+
+def test_supervisor_retries_once_on_other_replica(fake_fleet):
+    transport, factory, made = fake_fleet
+    sup = ReplicaSupervisor(factory, replicas=2, health_interval_s=60.0,
+                            sleep=lambda s: None).start()
+    try:
+        ok = (200, {"values": [1.0]})
+        transport.responses[made[0].url] = OSError("connection reset")
+        transport.responses[made[1].url] = ok
+        before = _counters()
+        payload = {"rows": [[1, 2, 3, 4]]}
+        for _ in range(2):  # round-robin guarantees one lands on the
+            status, body = sup.predict(payload)  # broken replica
+            assert (status, body) == ok
+        assert transport.predicts_to(made[0].url), \
+            "test never exercised the broken replica"
+        # the retry re-sent the SAME payload (idempotent replay)
+        assert all(c[2] == payload
+                   for c in transport.predicts_to(made[0].url)
+                   + transport.predicts_to(made[1].url))
+        after = _counters()
+        assert after.get("serving.fleet.retries", 0) \
+            >= before.get("serving.fleet.retries", 0) + 1
+        # the transport failure marked replica 0 suspect: until a
+        # health check clears it, routing skips it entirely
+        n_before = len(transport.predicts_to(made[0].url))
+        for _ in range(4):
+            assert sup.predict(payload) == ok
+        assert len(transport.predicts_to(made[0].url)) == n_before
+    finally:
+        sup.stop()
+    assert all(h.terminated for h in made[:2])
+
+
+def test_supervisor_retries_503_and_returns_it_without_peer(fake_fleet):
+    transport, factory, made = fake_fleet
+    sup = ReplicaSupervisor(factory, replicas=2, health_interval_s=60.0,
+                            sleep=lambda s: None).start()
+    try:
+        draining = (503, {"error": "draining", "reason": "draining"})
+        ok = (200, {"values": [2.0]})
+        transport.responses[made[0].url] = draining
+        transport.responses[made[1].url] = ok
+        for _ in range(2):
+            assert sup.predict({"rows": [[0, 0, 0, 0]]}) == ok
+        # with NO peer left, the 503 comes back to the caller (it is
+        # the client's retry-after signal, not a fleet failure)
+        transport.responses[made[1].url] = draining
+        made[1].rc = 1  # dead: routing can only offer replica 0
+        status, _body = sup.predict({"rows": [[0, 0, 0, 0]]})
+        assert status == 503
+    finally:
+        sup.stop()
+
+
+def test_supervisor_backoff_and_budget_exhaustion(fake_fleet, tmp_path):
+    transport, factory, made = fake_fleet
+    sleeps = []
+    sup = ReplicaSupervisor(factory, replicas=1, restart_budget=3,
+                            backoff_base_s=0.1, backoff_max_s=10.0,
+                            health_interval_s=60.0, seed=7,
+                            sleep=sleeps.append).start()
+    flightrec.set_dump_dir(str(tmp_path))
+    try:
+        transport.responses["http://replica-"] = (200, {})
+        slot = sup._slots[0]
+        for attempt in range(3):
+            slot.handle.rc = 1  # crash the current incumbent
+            sup._restart(slot)
+        assert sup.restarts_total == 3
+        assert len(sleeps) == 3
+        # jittered exponential: each delay in [0.5, 1.5) x base*2^k
+        for k, delay in enumerate(sleeps):
+            assert 0.5 * 0.1 * 2 ** k <= delay < 1.5 * 0.1 * 2 ** k
+        assert slot.backoff_history == sleeps
+        # budget exhausted: the fleet fails LOUDLY, not silently
+        slot.handle.rc = 1
+        with pytest.raises(FleetBudgetExhausted):
+            sup._restart(slot)
+        with pytest.raises(FleetBudgetExhausted):
+            sup.predict({"rows": [[0, 0, 0, 0]]})
+        assert sup.describe()["failed"]
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flightrec_") and f.endswith(".json")]
+        assert dumps, "budget exhaustion must dump the flight recorder"
+        events = json.load(open(tmp_path / dumps[0]))["events"]
+        assert any(e["kind"] == "fleet_budget_exhausted" for e in events)
+    finally:
+        sup.stop()
+
+
+def test_scale_decision_policy():
+    dec = ReplicaSupervisor.scale_decision
+    # pressure (depth or recent sheds) with headroom -> up
+    assert dec([100, 80], 0, 2, 2, 4, 64, 0) == "up"
+    assert dec([0, 0], 5, 2, 2, 4, 64, 0) == "up"
+    # at the ceiling, pressure holds instead of scaling
+    assert dec([100, 100], 9, 4, 2, 4, 64, 0) == "hold"
+    # idle long enough above the floor -> down; at the floor -> hold
+    idle = supervisor_mod.SCALE_DOWN_ROUNDS
+    assert dec([0, 0, 0], 0, 3, 2, 4, 64, idle) == "down"
+    assert dec([0, 0], 0, 2, 2, 4, 64, idle) == "hold"
+    # below the floor is always up (a replica just died)
+    assert dec([], 0, 1, 2, 4, 64, 0) == "up"
+
+
+def test_fleet_front_end_healthz_and_predict(fake_fleet):
+    import urllib.request
+
+    transport, factory, made = fake_fleet
+    sup = ReplicaSupervisor(factory, replicas=1, health_interval_s=60.0,
+                            sleep=lambda s: None).start()
+    front = FleetFrontEnd(sup, host="127.0.0.1", port=0)
+    try:
+        transport.responses[made[0].url] = (200, {"values": [4.5]})
+        with urllib.request.urlopen(front.url + "/v1/healthz",
+                                    timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["replicas"]
+        assert health["restarts_total"] == 0
+        assert health["restart_budget"] >= 1
+        req = urllib.request.Request(
+            front.url + "/v1/predict",
+            data=json.dumps({"rows": [[0, 0, 0, 0]]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["values"] == [4.5]
+    finally:
+        front.close()
+        sup.stop()
+
+
+# --------------------------------------------------------- benchdiff kind
+def _fleet_artifact(tmp_path, name, p99=80.0, offered_rps=10000.0,
+                    shed_rate=0.5, failed=0, bound_held=True,
+                    accepted_rps=2000.0):
+    art = {
+        "schema": "lightgbm-tpu/serving-fleet/v1",
+        "fleet": {
+            "mode": "overload", "sustainable_rps": 5000.0,
+            "overload_factor": 2.0, "offered": 60000,
+            "offered_rps": offered_rps, "accepted": 12000,
+            "accepted_rps": accepted_rps, "completed": 12000,
+            "shed": {"queue_full": 48000}, "shed_total": 48000,
+            "shed_rate": shed_rate, "failed": failed,
+            "accepted_p50_ms": 12.0, "accepted_p99_ms": p99,
+            "deadline_ms": 250.0, "max_queue_rows": 1024,
+            "max_pending_rows_observed": 1024 if bound_held else 2048,
+            "queue_bound_held": bound_held, "dispatcher_alive": True,
+        },
+        "shape": {"clients": 16, "rows_per_request": 64},
+    }
+    p = tmp_path / name
+    p.write_text(json.dumps(art))
+    return str(p)
+
+
+def test_benchdiff_fleet_kind_gates(tmp_path):
+    bd = _load_tool("benchdiff")
+    old = _fleet_artifact(tmp_path, "old.json")
+    assert bd.main([old, old]) == 0
+
+    # accepted-p99 blowup past the phase threshold
+    slow = _fleet_artifact(tmp_path, "slow.json", p99=120.0)
+    assert bd.main([old, slow]) == 1
+    rep = bd.diff(bd.normalize(old), bd.normalize(slow))
+    assert any("p99" in r for r in rep["regressions"])
+
+    # ANY failed request regresses outright
+    failed = _fleet_artifact(tmp_path, "failed.json", failed=3)
+    rep = bd.diff(bd.normalize(old), bd.normalize(failed))
+    assert any("FAILED" in r for r in rep["regressions"])
+
+    # a leaked queue bound regresses outright
+    leak = _fleet_artifact(tmp_path, "leak.json", bound_held=False)
+    rep = bd.diff(bd.normalize(old), bd.normalize(leak))
+    assert any("bound" in r for r in rep["regressions"])
+
+    # shed-rate growth at FLAT offered load is a protection regression
+    shed = _fleet_artifact(tmp_path, "shed.json", shed_rate=0.75)
+    rep = bd.diff(bd.normalize(old), bd.normalize(shed))
+    assert any("shed_rate" in r for r in rep["regressions"])
+
+    # ... but at materially HIGHER offered load it only warns: shedding
+    # more because more was offered is the mechanism working
+    pushed = _fleet_artifact(tmp_path, "pushed.json", shed_rate=0.75,
+                             offered_rps=20000.0)
+    rep = bd.diff(bd.normalize(old), bd.normalize(pushed))
+    assert not any("shed_rate" in r for r in rep["regressions"])
+    assert any("not comparable" in w for w in rep["warnings"])
+
+
+def test_benchdiff_fleet_kind_mismatches_exit_2(tmp_path):
+    bd = _load_tool("benchdiff")
+    fleet = _fleet_artifact(tmp_path, "fleet.json")
+    serving = tmp_path / "serving.json"
+    serving.write_text(json.dumps({
+        "schema": "lightgbm-tpu/serving-bench/v1",
+        "serving": {"mode": "online", "p50_ms": 1.0, "p99_ms": 2.0,
+                    "throughput_rps": 100.0, "error_rate": 0.0},
+    }))
+    training = tmp_path / "training.json"
+    training.write_text(json.dumps(
+        {"metric": "leafwise", "value": 0.4, "unit": "s/tree"}))
+    assert bd.main([fleet, str(serving)]) == 2
+    assert bd.main([str(serving), fleet]) == 2
+    assert bd.main([fleet, str(training)]) == 2
+    assert bd.main([str(training), fleet]) == 2
+
+
+def test_committed_fleet_artifact():
+    """The committed .bench/serving_fleet.json is the PR's overload
+    acceptance evidence: real demand above capacity, zero failures,
+    the queue bound held, and the dispatcher survived."""
+    path = os.path.join(ROOT, ".bench", "serving_fleet.json")
+    with open(path) as fh:
+        art = json.load(fh)
+    assert art["schema"] == "lightgbm-tpu/serving-fleet/v1"
+    f = art["fleet"]
+    assert f["failed"] == 0
+    assert f["queue_bound_held"] is True
+    assert f["dispatcher_alive"] is True
+    assert f["shed_total"] > 0 and 0.0 < f["shed_rate"] < 1.0
+    assert f["offered_rps"] > f["sustainable_rps"]
+    assert f["accepted_p99_ms"] <= f["deadline_ms"]
+    assert os.path.exists(os.path.join(
+        ROOT, ".bench", "serving_fleet.manifest.json"))
+    bd = _load_tool("benchdiff")
+    rec = bd.normalize(path)  # and it stays benchdiff-consumable
+    assert rec["kind"] == "fleet"
+    # the committed artifact passes its own gate (the baseline the
+    # next PR's overload run will diff against)
+    assert bd.main([path, path]) == 0

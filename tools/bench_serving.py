@@ -18,11 +18,24 @@ diffable by ``tools/benchdiff.py``:
   N-row CSV through the OLD strictly-sequential path and the overlapped
   parse->predict->write pipeline (serving/batch.py), byte-comparing the
   outputs and reporting the speedup.
+* **overload** (``--overload``) — emits the THIRD artifact kind
+  (``.bench/serving_fleet.json``, schema
+  ``lightgbm-tpu/serving-fleet/v1``): calibrates the sustainable
+  closed-loop throughput, then fires ~2x that demand open-loop (on the
+  clock, whether or not earlier requests finished — that is what an
+  overload IS) at a BOUNDED queue with per-request deadlines.  Reports
+  offered vs accepted rates, the shed split by reason
+  (queue_full/deadline/evicted), the shed rate, and accepted
+  p50/p99 — the latency admission control protects by shedding.
+  Every request must resolve as accepted-and-answered or shed-with-a-
+  typed-status: ``failed`` > 0, a leaked queue bound, or a dead
+  dispatcher fails the bench (and regresses in benchdiff).
 
 Usage:
     python tools/bench_serving.py                      # online, default shape
     python tools/bench_serving.py --requests 4000 --clients 64 --swap
     python tools/bench_serving.py --batch-rows 200000
+    python tools/bench_serving.py --overload           # saturation tier
     python tools/bench_serving.py --model m.txt --out-dir .bench
 """
 
@@ -40,6 +53,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
+FLEET_SCHEMA = "lightgbm-tpu/serving-fleet/v1"
 
 
 def log(msg: str) -> None:
@@ -207,6 +221,176 @@ def bench_online(args, model: str, model2: str) -> dict:
     return result
 
 
+# ----------------------------------------------------------- overload tier
+def bench_overload(args, model: str) -> dict:
+    """Saturation tier: measure what the admission layer does when
+    demand exceeds capacity.  Phase 1 calibrates the sustainable
+    closed-loop rate (clients wait for each answer — the natural
+    ceiling).  Phase 2 fires ``--overload-factor`` times that rate
+    OPEN-loop: requests go on the clock whether or not earlier ones
+    finished, against a bounded queue with per-request deadlines.  The
+    contract under test: every request resolves as answered or
+    shed-with-a-typed-status, the queue never exceeds its row bound,
+    and the dispatcher survives."""
+    import numpy as np
+
+    from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+    from lightgbm_tpu.serving.queue import RequestShed
+
+    engine = ServingEngine(model, max_batch_rows=args.max_batch_rows)
+    nf = engine.num_features
+    pool = np.random.RandomState(args.seed).randn(8192, nf)
+    rows = args.rows_max  # fixed-size requests: offered load in rows
+    # is determinate, so shed rates are comparable run-to-run
+
+    # ---- phase 1: closed-loop calibration (unbounded queue — the
+    # ceiling admission control exists to protect)
+    cal_q = MicroBatchQueue(engine, max_delay_s=args.max_delay_ms / 1e3)
+    lock = threading.Lock()
+    cal_done = [0]
+    stop = threading.Event()
+
+    def cal_client(idx: int) -> None:
+        rng = np.random.RandomState(args.seed + idx)
+        while not stop.is_set():
+            lo = rng.randint(0, len(pool) - rows)
+            cal_q.predict(pool[lo:lo + rows], timeout=60.0)
+            with lock:
+                cal_done[0] += 1
+
+    cal_threads = [threading.Thread(target=cal_client, args=(i,),
+                                    daemon=True)
+                   for i in range(args.clients)]
+    t0 = time.perf_counter()
+    for t in cal_threads:
+        t.start()
+    time.sleep(args.calibrate_seconds)
+    stop.set()
+    for t in cal_threads:
+        t.join(30.0)
+    cal_wall = time.perf_counter() - t0
+    cal_q.close()
+    sustainable_rps = cal_done[0] / cal_wall
+    offered_target_rps = sustainable_rps * args.overload_factor
+    log(f"overload: calibrated sustainable ~{sustainable_rps:.1f} req/s "
+        f"({rows} rows each); offering ~{offered_target_rps:.1f} req/s "
+        f"({args.overload_factor:g}x) for {args.overload_seconds:g}s")
+
+    # ---- phase 2: open-loop overload at a bounded queue
+    q = MicroBatchQueue(engine, max_delay_s=args.max_delay_ms / 1e3,
+                        max_queue_rows=args.overload_queue_rows)
+    lat: list = []
+    sheds: dict = {}
+    failures: list = []
+    offered = [0]
+    max_pending = [0]
+    interval = args.clients / max(offered_target_rps, 1e-6)
+    t_end = time.perf_counter() + args.overload_seconds
+
+    # queue-depth watermark from ONE sampler thread: sampling from the
+    # hot path would add a lock acquisition per request, contending
+    # with the dispatcher for the very lock the bench is loading
+    def sampler() -> None:
+        while time.perf_counter() < t_end:
+            max_pending[0] = max(max_pending[0], q.pending_rows)
+            time.sleep(0.001)
+
+    def load_client(idx: int) -> None:
+        # per-client local tallies, merged under the lock once at the
+        # end — the submit path itself must carry no shared state
+        rng = np.random.RandomState(args.seed + 1000 + idx)
+        futs = []
+        my_sheds: dict = {}
+        my_fail: list = []
+        my_offered = 0
+        next_fire = time.perf_counter() + (idx / args.clients) * interval
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            if now < next_fire:
+                time.sleep(min(next_fire - now, 0.005))
+                continue
+            next_fire += interval
+            lo = rng.randint(0, len(pool) - rows)
+            my_offered += 1
+            try:
+                futs.append(q.submit(
+                    pool[lo:lo + rows],
+                    deadline_ms=args.deadline_ms,
+                    priority="interactive" if idx % 2 == 0 else "batch"))
+            except RequestShed as e:
+                my_sheds[e.reason] = my_sheds.get(e.reason, 0) + 1
+            except Exception as e:  # never expected: the contract broke
+                my_fail.append(f"submit {type(e).__name__}: {e}")
+        my_lat = []
+        for f in futs:
+            try:
+                res = f.result(timeout=120.0)
+                my_lat.append(res.latency_s)
+            except RequestShed as e:  # admitted, then deadline-expired
+                my_sheds[e.reason] = my_sheds.get(e.reason, 0) + 1
+            except Exception as e:
+                my_fail.append(f"result {type(e).__name__}: {e}")
+        with lock:
+            offered[0] += my_offered
+            lat.extend(my_lat)
+            failures.extend(my_fail)
+            for k, v in my_sheds.items():
+                sheds[k] = sheds.get(k, 0) + v
+
+    load_threads = [threading.Thread(target=load_client, args=(i,),
+                                     daemon=True)
+                    for i in range(args.clients)]
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    t0 = time.perf_counter()
+    sampler_t.start()
+    for t in load_threads:
+        t.start()
+    for t in load_threads:
+        t.join(args.overload_seconds + 150.0)
+    sampler_t.join(5.0)
+    wall = time.perf_counter() - t0
+    dispatcher_alive = q.dispatcher_alive
+    q.close()
+
+    lat.sort()
+    shed_total = sum(sheds.values())
+    result = {
+        "mode": "overload",
+        "sustainable_rps": round(sustainable_rps, 1),
+        "overload_factor": args.overload_factor,
+        "offered": offered[0],
+        "offered_rps": round(offered[0] / args.overload_seconds, 1),
+        "accepted": len(lat),
+        "accepted_rps": round(len(lat) / args.overload_seconds, 1),
+        "completed": len(lat),
+        "shed": dict(sorted(sheds.items())),
+        "shed_total": shed_total,
+        "shed_rate": round(shed_total / max(offered[0], 1), 4),
+        "failed": len(failures),
+        "failures": failures[:5],
+        "accepted_p50_ms": round(_percentile(lat, 50) * 1e3, 4),
+        "accepted_p99_ms": round(_percentile(lat, 99) * 1e3, 4),
+        "accepted_mean_ms": round(
+            sum(lat) / max(len(lat), 1) * 1e3, 4),
+        "rows_per_request": rows,
+        "deadline_ms": args.deadline_ms,
+        "max_queue_rows": args.overload_queue_rows,
+        "max_pending_rows_observed": max_pending[0],
+        "queue_bound_held": max_pending[0] <= args.overload_queue_rows,
+        "dispatcher_alive": dispatcher_alive,
+        "wall_s": round(wall, 4),
+    }
+    log(f"overload: offered {offered[0]} "
+        f"({result['offered_rps']} req/s), accepted {len(lat)} "
+        f"(p50 {result['accepted_p50_ms']}ms "
+        f"p99 {result['accepted_p99_ms']}ms), shed {shed_total} "
+        f"({result['shed_rate']:.1%}: {result['shed']}), "
+        f"failed {len(failures)}")
+    return result
+
+
 # -------------------------------------------------------------- batch tier
 def bench_batch(args, model: str, tmp: str) -> dict:
     import numpy as np
@@ -304,6 +488,20 @@ def main() -> int:
     ap.add_argument("--swap", action="store_true",
                     help="hot-swap to a continued-training model at the "
                          "halfway mark, under load")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the saturation tier: calibrate the "
+                         "sustainable rate, then offer a multiple of "
+                         "it at a bounded queue (serving_fleet.json)")
+    ap.add_argument("--overload-factor", type=float, default=2.0,
+                    help="offered load as a multiple of the calibrated "
+                         "sustainable rate")
+    ap.add_argument("--overload-seconds", type=float, default=6.0)
+    ap.add_argument("--calibrate-seconds", type=float, default=2.0)
+    ap.add_argument("--overload-queue-rows", type=int, default=1024,
+                    help="queue row bound for the overload tier "
+                         "(serve_max_queue_rows)")
+    ap.add_argument("--deadline-ms", type=float, default=250.0,
+                    help="per-request deadline in the overload tier")
     ap.add_argument("--batch-rows", type=int, default=0,
                     help="also run the batch tier at this row count")
     ap.add_argument("--batch-chunk-rows", type=int, default=20000)
@@ -326,7 +524,7 @@ def main() -> int:
     tmp = tempfile.mkdtemp(prefix="lgbm_bench_serving_")
     os.makedirs(args.out_dir, exist_ok=True)
     run_online = (args.online if args.online is not None
-                  else args.batch_rows == 0)
+                  else args.batch_rows == 0 and not args.overload)
 
     model = args.model or train_model(
         tmp, args.train_rows, args.features, args.trees, args.leaves,
@@ -372,6 +570,43 @@ def main() -> int:
             rc = 1
         if serving["errors"]:
             log(f"FAIL: {serving['errors']} request errors")
+            rc = 1
+
+    if args.overload:
+        fleet = bench_overload(args, model)
+        from lightgbm_tpu.serving.engine import ServingEngine
+
+        artifact = {
+            "schema": FLEET_SCHEMA,
+            "created_unix": round(time.time(), 3),
+            "fleet": fleet,
+            "shape": {"clients": args.clients,
+                      "rows_per_request": args.rows_max,
+                      "overload_factor": args.overload_factor,
+                      "overload_seconds": args.overload_seconds,
+                      "deadline_ms": args.deadline_ms,
+                      "max_queue_rows": args.overload_queue_rows,
+                      "max_delay_ms": args.max_delay_ms,
+                      "max_batch_rows": args.max_batch_rows,
+                      "trees": args.trees, "leaves": args.leaves,
+                      "features": args.features, "seed": args.seed},
+        }
+        out = os.path.join(args.out_dir, f"serving_fleet{suffix}.json")
+        atomic_write_json(out, artifact)
+        eng = ServingEngine(model, max_batch_rows=8, warm=False,
+                            require_checksum=False)
+        write_serving_manifest(
+            eng, out.replace(".json", ".manifest.json"), result=fleet)
+        log(f"wrote {out}")
+        if fleet["failed"]:
+            log(f"FAIL: {fleet['failed']} request(s) FAILED — overload "
+                "must shed with a typed status, never fail")
+            rc = 1
+        if not fleet["queue_bound_held"]:
+            log("FAIL: queue leaked past its row bound under overload")
+            rc = 1
+        if not fleet["dispatcher_alive"]:
+            log("FAIL: dispatcher died under overload")
             rc = 1
 
     if args.batch_rows > 0:

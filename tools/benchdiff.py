@@ -32,6 +32,17 @@ Accepted input formats (auto-detected per file):
   regress 3x while the headline hides it in noise.  Batch mode diffs
   file-to-file seconds.  Serving and training artifacts are never
   cross-compared (exit 2).
+* serving fleet artifacts (``.bench/serving_fleet.json`` —
+  ``lightgbm-tpu/serving-fleet/v1`` from ``bench_serving.py
+  --overload``): the headline is ACCEPTED p99 — the latency the
+  admission layer protects by shedding — gated at the phase threshold;
+  any failed request is a regression outright (overload must shed,
+  never fail), as is a leaked queue bound or a dead dispatcher; the
+  shed rate is only judged at ~flat offered load (shedding more
+  because more was offered is the mechanism working, not breaking),
+  where growth past an absolute floor plus the phase threshold is a
+  protection regression.  Fleet artifacts are never cross-compared
+  with any other kind (exit 2).
 * forest bench artifacts  (``.bench/forest_sweep.json`` —
   ``lightgbm-tpu/forest-bench/v1`` from tools/bench_forest.py):
   headline is the batched forest wall (ONE program advancing all N
@@ -74,6 +85,10 @@ MANIFEST_SCHEMA = "lightgbm-tpu/run-manifest/v1"
 SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
 MULTICHIP_SCHEMA = "lightgbm-tpu/multichip-bench/v1"
 FOREST_SCHEMA = "lightgbm-tpu/forest-bench/v1"
+FLEET_SCHEMA = "lightgbm-tpu/serving-fleet/v1"
+# shed-rate noise floor (absolute fraction of offered requests): below
+# this, a shed-rate delta at flat load is sampling noise, not a signal
+FLEET_SHED_ABS = 0.02
 # cross-rank skew gate: a skew below this absolute floor is scheduling
 # noise on any backend — relative growth only matters above it
 SKEW_ABS_FLOOR_S = 0.02
@@ -143,6 +158,33 @@ def _normalize_forest(raw: dict, rec: dict) -> dict:
     return rec
 
 
+def _normalize_fleet(raw: dict, rec: dict) -> dict:
+    """Serving-fleet overload artifacts (tools/bench_serving.py
+    --overload): headline is the ACCEPTED p99 — the latency the
+    admission layer protects by shedding; offered/accepted rates, the
+    shed split, and the failure count ride in ``aux`` for the
+    fleet-specific gates."""
+    f = dict(raw.get("fleet") or {})
+    rec["kind"] = "fleet"
+    rec["value"] = f.get("accepted_p99_ms")
+    rec["unit"] = "ms accepted-p99"
+    rec["aux"] = {k: f.get(k) for k in
+                  ("sustainable_rps", "offered_rps", "accepted_rps",
+                   "offered", "accepted", "completed", "shed_total",
+                   "shed_rate", "failed", "accepted_p50_ms",
+                   "deadline_ms", "max_queue_rows",
+                   "max_pending_rows_observed", "queue_bound_held",
+                   "dispatcher_alive", "overload_factor")
+                  if f.get(k) is not None}
+    rec["shed"] = dict(f.get("shed") or {})
+    rec["shape"] = raw.get("shape") or {}
+    if rec.get("value") in (None, 0, 0.0):
+        raise ValueError(
+            f"{rec['path']}: fleet artifact has no usable headline "
+            "(fleet.accepted_p99_ms)")
+    return rec
+
+
 def _normalize_multichip(raw: dict, rec: dict) -> dict:
     """Multichip artifacts: headline from ``result.value``; the skew
     tables (span + reservoir, already ``{name: {max_minus_min_s, ...}}``)
@@ -188,6 +230,8 @@ def normalize(path: str) -> dict:
     raw = _load(path)
     rec: dict = {"label": os.path.basename(path), "path": path,
                  "phases": {}, "sha": None, "kind": "training"}
+    if raw.get("schema") == FLEET_SCHEMA:
+        return _normalize_fleet(raw, rec)
     if raw.get("schema") == FOREST_SCHEMA:
         return _normalize_forest(raw, rec)
     if raw.get("schema") == MULTICHIP_SCHEMA:
@@ -357,6 +401,96 @@ def diff_serving(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
         warnings.append(
             f"load shapes differ (old: {os_}, new: {ns}) — comparison "
             "may not be apples-to-apples")
+    return {"headline": headline, "regressions": regressions,
+            "warnings": warnings, "improvements": improvements}
+
+
+def diff_fleet(old: dict, new: dict,
+               headline_pct: float = HEADLINE_PCT,
+               phase_pct: float = PHASE_PCT) -> dict:
+    """Serving-fleet overload comparison.  The headline is accepted-p99
+    gated at ``phase_pct`` (tail latency at deliberate saturation is
+    noisier than a steady-state p99, so it gets the looser phase
+    threshold).  Gates that are never perf tradeoffs: any failed
+    request is a regression outright (overload must shed with a typed
+    status, never fail), as is a queue that leaked past its row bound
+    or a dispatcher that died.  The shed rate is only judged when the
+    offered load is ~flat (within ``headline_pct``): shedding more
+    because MORE was offered is the admission layer working; shedding
+    more at the SAME offered load means the service got less able to
+    absorb the same demand."""
+    regressions, warnings, improvements = [], [], []
+    unit = new.get("unit", "ms accepted-p99")
+    ov, nv = float(old["value"]), float(new["value"])
+    head = _pct(ov, nv)
+    headline = {"old": ov, "new": nv, "unit": unit,
+                "delta_pct": round(head, 1)}
+    if head >= phase_pct:
+        regressions.append(
+            f"accepted p99 {ov:.4g} -> {nv:.4g} ms (+{head:.1f}%, "
+            f"threshold +{phase_pct:.0f}%) — the latency shedding is "
+            "supposed to protect")
+    elif head <= -phase_pct:
+        improvements.append(
+            f"accepted p99 {ov:.4g} -> {nv:.4g} ms ({head:.1f}%)")
+
+    oa, na = old.get("aux") or {}, new.get("aux") or {}
+    # correctness gates first: these are never perf tradeoffs
+    if int(na.get("failed") or 0) > 0:
+        regressions.append(
+            f"NEW run FAILED {na['failed']} request(s) — an overloaded "
+            "fleet must shed with a typed status, never fail")
+    if na.get("queue_bound_held") is False:
+        regressions.append(
+            "NEW run's queue leaked past its row bound "
+            f"(observed {na.get('max_pending_rows_observed')} > "
+            f"{na.get('max_queue_rows')} rows) — admission control is "
+            "not actually bounding memory")
+    if na.get("dispatcher_alive") is False:
+        regressions.append(
+            "NEW run's dispatcher died under overload — shedding must "
+            "leave the serving loop standing")
+
+    oo = float(oa.get("offered_rps") or 0)
+    no_ = float(na.get("offered_rps") or 0)
+    osr = float(oa.get("shed_rate") or 0)
+    nsr = float(na.get("shed_rate") or 0)
+    if oo > 0 and no_ > 0:
+        load_delta = _pct(oo, no_)
+        if abs(load_delta) < headline_pct:
+            rel = _pct(osr, nsr) if osr > 0 else float("inf")
+            if nsr > osr + FLEET_SHED_ABS and rel >= phase_pct:
+                regressions.append(
+                    f"shed_rate {osr:.4f} -> {nsr:.4f} at ~flat offered "
+                    f"load ({oo:.4g} -> {no_:.4g} req/s) — the service "
+                    "got less able to absorb the same demand")
+            elif osr > nsr + FLEET_SHED_ABS:
+                improvements.append(
+                    f"shed_rate {osr:.4f} -> {nsr:.4f} at ~flat offered "
+                    f"load ({oo:.4g} -> {no_:.4g} req/s)")
+        else:
+            warnings.append(
+                f"offered load moved {oo:.4g} -> {no_:.4g} req/s "
+                f"({load_delta:+.1f}%) — shed rates ({osr:.4f} vs "
+                f"{nsr:.4f}) are not comparable across different demand")
+    oar, nar = oa.get("accepted_rps"), na.get("accepted_rps")
+    if oar and nar:
+        d = _pct(float(oar), float(nar))
+        if d <= -headline_pct:
+            regressions.append(
+                f"accepted throughput {float(oar):.4g} -> "
+                f"{float(nar):.4g} req/s ({d:.1f}%, threshold "
+                f"-{headline_pct:.0f}%)")
+        elif d >= headline_pct:
+            improvements.append(
+                f"accepted throughput {float(oar):.4g} -> "
+                f"{float(nar):.4g} req/s ({d:+.1f}%)")
+
+    os_, ns = old.get("shape") or {}, new.get("shape") or {}
+    if os_ and ns and os_ != ns:
+        warnings.append(
+            f"overload shapes differ (old: {os_}, new: {ns}) — "
+            "comparison may not be apples-to-apples")
     return {"headline": headline, "regressions": regressions,
             "warnings": warnings, "improvements": improvements}
 
@@ -545,6 +679,15 @@ def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
     """Compare two normalized records; returns
     ``{regressions: [...], warnings: [...], improvements: [...],
     headline: {...}}``."""
+    if "fleet" in (old.get("kind"), new.get("kind")):
+        if old.get("kind") != new.get("kind"):
+            raise ValueError(
+                f"{old['label']} is a {old.get('kind')} artifact, "
+                f"{new['label']} is a {new.get('kind')} artifact — "
+                "fleet-overload and other results are not comparable "
+                "(an overload shed-rate has no meaning against a "
+                "steady-state serving bench)")
+        return diff_fleet(old, new, headline_pct, phase_pct)
     if "forest" in (old.get("kind"), new.get("kind")):
         if old.get("kind") != new.get("kind"):
             raise ValueError(
@@ -718,7 +861,7 @@ def main(argv: Optional[list] = None) -> int:
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta}) at num_models="
               f"{h.get('num_models')}")
-    elif new.get("kind") == "serving":
+    elif new.get("kind") in ("serving", "fleet"):
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta})")
     else:
@@ -730,7 +873,8 @@ def main(argv: Optional[list] = None) -> int:
         print(f"  warning: {w}")
     for i in report["improvements"]:
         print(f"  improvement: {i}")
-    if new.get("kind") not in ("serving", "multichip", "forest"):
+    if new.get("kind") not in ("serving", "multichip", "forest",
+                               "fleet"):
         print("  driver-config row (paste into the commit message):")
         print("  " + driver_row(new))
 

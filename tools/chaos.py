@@ -39,6 +39,29 @@ status; the fault matrix lives in docs/resilience.md):
   a flight-recorder post-mortem (tail = ``oom``) carrying the last
   live-buffer census AND the analytic memmodel prediction for the
   failing shape (obs/memory.py, docs/memory.md), then re-raise.
+* ``overload_shed`` — flood a BOUNDED serving queue behind a slowed
+  device: pending rows never exceed the bound, queue-full refusals are
+  429 with Retry-After, expired deadlines shed in-queue (504, never
+  dispatched), an interactive arrival evicts the newest batch rider,
+  every accepted request still answers bitwise with stages summing
+  exactly to its latency, and the dispatcher survives the storm.
+* ``serve_drain`` — graceful serving drain: healthz flips to
+  503/``draining``, new admissions are refused (503 + Retry-After),
+  everything already admitted finishes bitwise; the subprocess variant
+  SIGTERMs a real ``task=serve`` process and asserts exit 75 plus a
+  flight-recorder dump (tail = ``drain``) — the same preemption
+  contract a training run honors.
+* ``replica_kill`` — kill one replica of a supervised fleet UNDER LIVE
+  LOAD (abrupt listener teardown in dryrun, SIGKILL of a real serve
+  subprocess otherwise): ZERO requests fail (503/connection-reset is
+  retried once on a different replica), the supervisor restarts the
+  victim with backoff, and the fleet returns to full strength.
+* ``lockcheck_fleet`` — the fleet layer under the runtime lock
+  sanitizer (LGBM_TPU_LOCKCHECK=1, fresh process): bounded admission
+  with deadlines and priorities, a drain, and a supervised
+  kill-restart cycle must produce ZERO sanitizer findings while the
+  instrumented locks (queue.cond, supervisor.state) demonstrably saw
+  traffic.
 
 Modes:
 
@@ -76,7 +99,9 @@ sys.path.insert(0, ROOT)
 
 SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
              "collective", "serve_swap", "serve_fail_write",
-             "lockcheck_swap", "desync", "straggler", "oom_dispatch")
+             "lockcheck_swap", "desync", "straggler", "oom_dispatch",
+             "overload_shed", "serve_drain", "replica_kill",
+             "lockcheck_fleet")
 
 
 def log(msg: str) -> None:
@@ -597,6 +622,484 @@ def scenario_collective_inproc(tmp: str) -> str:
     return "transient collective failure -> retried and recovered"
 
 
+# ---------------------------------------------------------- serving fleet
+def _wait_until(pred, timeout: float = 30.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.002)
+    raise AssertionError(f"{what} not reached within {timeout}s")
+
+
+def _fleet_model(tmp: str, trees: int = 3) -> str:
+    """Train (once per scratch dir) the tiny model every serving-fleet
+    scenario serves — they assert resilience, not learning."""
+    model = os.path.join(tmp, "fleet_model.txt")
+    if not os.path.exists(model):
+        data = os.path.join(tmp, "fleet_train.csv")
+        make_data(data, 240, seed=17)
+        rc, _ = _run_inproc(train_args(data, model, trees) + ["verbose=-1"])
+        assert rc == 0, f"fleet model train rc={rc}"
+    return model
+
+
+class _SlowEngine:
+    """Delegating engine wrapper whose dispatch takes ``delay_s`` — the
+    brake that lets overload/drain scenarios build a real backlog."""
+
+    def __init__(self, inner, delay_s: float) -> None:
+        self._inner = inner
+        self._delay = delay_s
+        self.max_batch_rows = inner.max_batch_rows
+        self.num_features = inner.num_features
+
+    def predict_with_meta(self, X, raw_score: bool = False, clock=None):
+        time.sleep(self._delay)
+        return self._inner.predict_with_meta(X, raw_score=raw_score,
+                                             clock=clock)
+
+
+def scenario_overload_shed_inproc(tmp: str, trees: int) -> str:
+    """Overload scenario: flood a bounded queue behind a slowed device.
+    The admission layer must hold the row bound, shed with honest HTTP
+    mappings (429 queue-full with Retry-After, 504 expired deadline,
+    eviction of the newest batch rider by an interactive arrival), and
+    every ACCEPTED request must still answer bitwise with its four
+    stages summing exactly to its end-to-end latency — overload may
+    shed work, never corrupt it."""
+    import threading
+
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.obs import flightrec, telemetry
+    from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+    from lightgbm_tpu.serving.queue import DeadlineExpired, QueueFull
+
+    model = _fleet_model(tmp, trees)
+    engine = ServingEngine(model, buckets=(8, 32), max_batch_rows=32,
+                           require_checksum=False)
+    slow = _SlowEngine(engine, 0.05)
+    flightrec.set_dump_dir(tmp)
+    flightrec.reset()
+    X = np.random.RandomState(18).randn(8, 6)
+    exp = Booster(model_file=model).predict(X)
+    bound = 64
+    c0 = telemetry.get_telemetry().snapshot()["counters"]
+    q = MicroBatchQueue(slow, max_delay_s=0.001, max_queue_rows=bound)
+    over_bound = [0]
+    stop = threading.Event()
+
+    def sampler():  # watches the bound from outside, continuously
+        while not stop.is_set():
+            d = q.pending_rows
+            if d > bound:
+                over_bound[0] = max(over_bound[0], d)
+            time.sleep(0.001)
+
+    sam = threading.Thread(target=sampler)
+    sam.start()
+    try:
+        # occupy the device (50ms), then let tight deadlines die in
+        # the queue: they must be SHED there, never dispatched
+        hold1 = q.submit(X, trace_id="hold1")
+        _wait_until(lambda: q.depth == 0, what="hold1 taken")
+        dead = [q.submit(X, trace_id=f"dead{i}", deadline_ms=5)
+                for i in range(2)]
+        r_hold1 = hold1.result(timeout=30)
+        n_504 = 0
+        for f in dead:
+            try:
+                f.result(timeout=30)
+                raise AssertionError("expired request WAS dispatched")
+            except DeadlineExpired as e:
+                assert e.http_status == 504, e.http_status
+                n_504 += 1
+        # occupy again, fill the bound to the brim with batch work
+        hold2 = q.submit(X, trace_id="hold2")
+        _wait_until(lambda: q.depth == 0, what="hold2 taken")
+        lo = [q.submit(X, trace_id=f"lo{i}", priority="batch")
+              for i in range(bound // 8)]
+        try:  # one more over the bound -> refused, 429 + Retry-After
+            q.submit(X, priority="batch")
+            raise AssertionError("over-bound batch submit was ADMITTED")
+        except QueueFull as e:
+            assert e.http_status == 429 and e.retry_after_s > 0, (
+                e.http_status, e.retry_after_s)
+        # an interactive arrival does NOT get refused: it sheds the
+        # newest batch rider instead (shed-lowest-first)
+        hi = q.submit(X, trace_id="hi", priority="interactive")
+        assert q.pending_rows <= bound, q.pending_rows
+        try:
+            lo[-1].result(timeout=30)
+            raise AssertionError("evicted batch request was dispatched")
+        except QueueFull as e:
+            assert "evicted" in str(e) and e.http_status == 429, e
+        accepted = [r_hold1, hold2.result(30), hi.result(30)]
+        accepted += [f.result(30) for f in lo[:-1]]
+        for r in accepted:
+            assert r.values.tobytes() == exp.tobytes(), (
+                "accepted request answered WRONG under overload")
+            s = sum(r.stages.values())
+            assert abs(s - r.latency_s) < 1e-6, (
+                f"stages sum {s} != latency {r.latency_s} ({r.stages})")
+        assert q.dispatcher_alive, "dispatcher died under overload"
+        sheds_60s = q.shed_last_60s
+        assert sheds_60s >= 4, sheds_60s
+    finally:
+        stop.set()
+        sam.join(10)
+        q.close()
+    assert over_bound[0] == 0, (
+        f"queue exceeded its row bound: {over_bound[0]} > {bound}")
+    c1 = telemetry.get_telemetry().snapshot()["counters"]
+
+    def delta(k):
+        return c1.get(k, 0) - c0.get(k, 0)
+
+    assert delta("serving.shed.deadline") >= 2, c1
+    assert delta("serving.shed.queue_full") >= 1, c1
+    assert delta("serving.shed.evicted") >= 1, c1
+    # the sheds are on the flight-recorder record too (the dump here is
+    # manual, so dispatches of accepted work may follow the last shed —
+    # assert presence + reasons, not the tail)
+    path = flightrec.dump(reason="overload_shed")
+    with open(path) as fh:
+        events = json.load(fh)["events"]
+    shed_reasons = {e.get("reason") for e in events
+                    if e["kind"] == "shed"}
+    assert {"deadline", "queue_full", "evicted"} <= shed_reasons, (
+        f"flight recorder missing shed kinds: {shed_reasons}")
+    return (f"bounded queue held {bound} rows under flood: "
+            f"{n_504} deadline sheds (504), queue-full refused (429 + "
+            "Retry-After), newest batch rider evicted for interactive, "
+            f"{len(accepted)} accepted answered bitwise with stage sums "
+            "exact, dispatcher alive")
+
+
+def scenario_serve_drain_inproc(tmp: str, trees: int) -> str:
+    """Drain semantics, in-process: ``begin_drain`` flips healthz to
+    503/``draining`` and refuses new work with a Retry-After, while
+    everything ALREADY ADMITTED still completes bitwise — the no-lost-
+    accepted-work half of the preemption contract (the full
+    SIGTERM -> exit-75 -> flightrec-dump path is the subprocess
+    variant)."""
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.serving import (MicroBatchQueue, ServingEngine,
+                                      ServingServer)
+    from lightgbm_tpu.serving.supervisor import _http_json
+
+    model = _fleet_model(tmp, trees)
+    engine = ServingEngine(model, buckets=(8, 32), max_batch_rows=32,
+                           require_checksum=False)
+    q = MicroBatchQueue(_SlowEngine(engine, 0.05), max_delay_s=0.001)
+    server = ServingServer(engine, q, port=0).start()
+    try:
+        X = np.random.RandomState(19).randn(8, 6)
+        exp = Booster(model_file=model).predict(X)
+        code, h = _http_json("GET", server.url + "/v1/healthz")
+        assert code == 200 and h["state"] == "serving", (code, h)
+        inflight = q.submit(X, trace_id="inflight")  # occupies device
+        tail = q.submit(X, trace_id="tail")          # admitted, queued
+        q.begin_drain()
+        code, h = _http_json("GET", server.url + "/v1/healthz")
+        assert code == 503 and h["state"] == "draining", (code, h)
+        # new admissions refused 503 + a Retry-After HEADER (the raw
+        # request, to see the headers the JSON helper swallows)
+        req = urllib.request.Request(
+            server.url + "/v1/predict",
+            data=json.dumps({"rows": X.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("predict ADMITTED while draining")
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read() or b"{}")
+            assert e.code == 503 and body["reason"] == "draining", (
+                e.code, body)
+            assert e.headers.get("Retry-After"), (
+                "draining refusal carries no Retry-After header")
+        # ... while the admitted work still finishes, bitwise
+        for f in (inflight, tail):
+            r = f.result(timeout=30)
+            assert r.values.tobytes() == exp.tobytes(), (
+                "admitted request lost/corrupted by drain")
+        q.drain()
+        assert q.state == "draining" and q.depth == 0
+    finally:
+        server.close()
+    return ("drain: healthz 503/draining, new work refused 503 + "
+            "Retry-After, admitted work finished bitwise, queue empty")
+
+
+def scenario_serve_drain_subproc(tmp: str, trees: int) -> str:
+    """The real thing: SIGTERM a live ``task=serve`` process — it must
+    answer until the signal, then drain and exit 75 (the training
+    preemption contract) leaving a flight-recorder dump whose tail is
+    the drain."""
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.serving.supervisor import _http_json
+
+    model = _fleet_model(tmp, trees)
+    ready = os.path.join(tmp, "serve_drain_ready.json")
+    p = _spawn_train(["task=serve", f"input_model={model}",
+                      "serve_port=0", f"serve_ready_file={ready}",
+                      "verbose=1"])
+    try:
+        _wait_until(lambda: os.path.exists(ready) or p.poll() is not None,
+                    timeout=120, what="serve replica ready")
+        assert p.poll() is None, f"serve exited early rc={p.poll()}"
+        url = json.load(open(ready))["url"]
+        X = np.random.RandomState(20).randn(8, 6)
+        exp = Booster(model_file=model).predict(X)
+        code, out = _http_json("POST", url + "/v1/predict",
+                               {"rows": X.tolist()})
+        assert code == 200, (code, out)
+        got = np.asarray(out["predictions"], dtype=np.float64)
+        assert got.tobytes() == exp.tobytes(), "pre-drain answer wrong"
+        p.send_signal(signal.SIGTERM)
+        out_text, _ = p.communicate(timeout=120)
+        assert p.returncode == 75, (
+            f"drained serve rc={p.returncode}, expected 75:\n"
+            f"{out_text[-1500:]}")
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.communicate(timeout=30)
+    # the drain left a post-mortem next to the model, tail = the drain
+    _assert_flightrec_dump(os.path.dirname(model), "drain", "drain")
+    return ("SIGTERM on live task=serve -> answered until signal, "
+            "drained, exit 75, flight-recorder dump (tail=drain)")
+
+
+def _drive_fleet_kill(tmp: str, trees: int, factory_kind: str,
+                      sup_kwargs: dict, load_after_kill_s: float) -> str:
+    """Shared replica_kill body: hammer a 2-replica supervised fleet
+    from concurrent clients, kill replica 0 mid-load, and assert ZERO
+    requests failed (the bounded retry-on-other-replica contract),
+    the victim was restarted, and every answer stayed bitwise."""
+    import threading
+
+    import numpy as np
+
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.serving.supervisor import (ReplicaSupervisor,
+                                                 SubprocessReplica,
+                                                 ThreadReplica)
+
+    model = _fleet_model(tmp, trees)
+    X = np.random.RandomState(21).randn(4, 6)
+    exp = Booster(model_file=model).predict(X)
+    rows = X.tolist()
+    if factory_kind == "thread":
+        def factory(i):
+            return ThreadReplica(model, i, max_queue_rows=4096)
+        sup = ReplicaSupervisor(factory, replicas=2, **sup_kwargs)
+    else:
+        fleet_dir = os.path.join(tmp, "fleet")
+        os.makedirs(fleet_dir, exist_ok=True)
+
+        def factory(i):
+            return SubprocessReplica(model, i, fleet_dir,
+                                     extra_args=("verbose=1",))
+        sup = ReplicaSupervisor(factory, replicas=2, **sup_kwargs)
+    sup.start()
+    failed, done = [], [0]
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                code, out = sup.predict({"rows": rows})
+                if code != 200:
+                    failed.append((code, out))
+                    continue
+                got = np.asarray(out["predictions"], dtype=np.float64)
+                if got.tobytes() != exp.tobytes():
+                    failed.append(("mismatch", out["predictions"]))
+                done[0] += 1
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                failed.append(("exc", f"{type(e).__name__}: {e}"))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        _wait_until(lambda: done[0] > 10, what="fleet warm traffic")
+        killed = sup.chaos_kill(0)
+        _wait_until(lambda: sup.restarts_total >= 1, timeout=240,
+                    what="victim restart")
+        time.sleep(load_after_kill_s)  # keep load through the recovery
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(60)
+        sup.stop()
+    assert not failed, (
+        f"{len(failed)} request(s) FAILED across a replica kill "
+        f"(first: {failed[:3]}) — the zero-loss retry contract is "
+        "broken")
+    assert done[0] > 0 and sup.restarts_total >= 1
+    return (f"replica {killed} killed under live load: {done[0]} "
+            "requests answered bitwise, ZERO failed, victim restarted "
+            f"(restarts={sup.restarts_total})")
+
+
+def scenario_replica_kill_inproc(tmp: str, trees: int) -> str:
+    return _drive_fleet_kill(
+        tmp, trees, "thread",
+        dict(restart_budget=4, backoff_base_s=0.05, backoff_max_s=0.2,
+             health_interval_s=0.1),
+        load_after_kill_s=0.3)
+
+
+def scenario_replica_kill_subproc(tmp: str, trees: int) -> str:
+    """SIGKILL of a REAL serve subprocess mid-load — connection resets
+    on in-flight sockets are the whole point."""
+    return _drive_fleet_kill(
+        tmp, trees, "subprocess",
+        dict(restart_budget=4, backoff_base_s=0.2, backoff_max_s=1.0,
+             health_interval_s=0.25, ready_timeout_s=180),
+        load_after_kill_s=1.0)
+
+
+_LOCKCHECK_FLEET_DRIVER = r"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.getcwd())
+
+import numpy as np
+
+from lightgbm_tpu.analysis import lockcheck
+
+assert lockcheck.enabled(), "LGBM_TPU_LOCKCHECK=1 did not take"
+
+from lightgbm_tpu.serving import MicroBatchQueue, ServingEngine
+from lightgbm_tpu.serving.queue import RequestShed
+from lightgbm_tpu.serving.supervisor import ReplicaSupervisor, ThreadReplica
+
+model = sys.argv[1]
+errs, shed_log = [], []
+
+# half 1: bounded admission with deadlines + priorities, then a drain,
+# all hammered from concurrent clients under the sanitizer
+engine = ServingEngine(model, buckets=(8, 32), max_batch_rows=32,
+                       require_checksum=False)
+q = MicroBatchQueue(engine, max_delay_s=0.001, max_queue_rows=64)
+X = np.random.RandomState(5).randn(8, 6)
+stop = threading.Event()
+
+
+def client(i):
+    k = 0
+    try:
+        while not stop.is_set():
+            k += 1
+            try:
+                q.predict(X, timeout=60,
+                          deadline_ms=(2 if k % 5 == 0 else None),
+                          priority=("batch" if (i + k) % 2 else
+                                    "interactive"))
+            except RequestShed:
+                shed_log.append(1)
+    except Exception as e:
+        errs.append(f"{type(e).__name__}: {e}")
+
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+time.sleep(0.8)
+q.begin_drain()       # clients now hammer the draining-shed path too
+time.sleep(0.1)
+stop.set()
+for t in threads:
+    t.join(60)
+q.close()
+
+# half 2: a supervised kill-restart cycle (supervisor.state lock)
+sup = ReplicaSupervisor(lambda i: ThreadReplica(model, i), replicas=1,
+                        restart_budget=2, backoff_base_s=0.01,
+                        backoff_max_s=0.02, health_interval_s=0.05)
+sup.start()
+code, out = sup.predict({"rows": X.tolist()})
+assert code == 200, (code, out)
+sup.chaos_kill(0)
+# restarts_total counts the ATTEMPT (budget semantics) before the
+# replacement is ready — poll until the fleet actually answers again
+code2 = None
+t0 = time.monotonic()
+while time.monotonic() - t0 < 120:
+    try:
+        code2, _ = sup.predict({"rows": X.tolist()})
+        if code2 == 200:
+            break
+    except Exception:
+        pass
+    time.sleep(0.05)
+restarts = sup.restarts_total
+sup.stop()
+
+print(json.dumps({
+    "errors": errs,
+    "findings": lockcheck.findings(),
+    "sheds": len(shed_log),
+    "restarts": restarts,
+    "post_restart_code": code2,
+    "acquisitions": {k: v["acquisitions"]
+                     for k, v in lockcheck.stats().items()},
+}))
+"""
+
+
+def scenario_lockcheck_fleet(tmp: str, trees: int) -> str:
+    """The whole fleet layer under the runtime lock sanitizer
+    (LGBM_TPU_LOCKCHECK=1 in a fresh process so module-level locks are
+    instrumented too): bounded admission under concurrent overload,
+    a drain, and a supervised kill-restart must produce ZERO findings
+    while the instrumented locks demonstrably saw the traffic."""
+    model = _fleet_model(tmp, trees)
+    driver = os.path.join(tmp, "lockcheck_fleet_driver.py")
+    with open(driver, "w", encoding="utf-8") as fh:
+        fh.write(_LOCKCHECK_FLEET_DRIVER)
+    r = subprocess.run(
+        [sys.executable, driver, model],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "LGBM_TPU_LOCKCHECK": "1"},
+    )
+    assert r.returncode == 0, (
+        f"driver rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["errors"] == [], f"client errors: {out['errors']}"
+    assert out["findings"] == [], (
+        "sanitizer findings under fleet load: "
+        + json.dumps(out["findings"])[:2000])
+    assert out["sheds"] > 0, "overload never actually shed"
+    assert out["restarts"] >= 1, "kill-restart cycle did not happen"
+    assert out["post_restart_code"] == 200, out["post_restart_code"]
+    acq = out["acquisitions"]
+    # silence only counts if the locks actually saw traffic
+    assert acq.get("queue.cond", 0) > 0, acq
+    assert acq.get("supervisor.state", 0) > 0, acq
+    return (f"fleet under LGBM_TPU_LOCKCHECK=1: {out['sheds']} sheds, "
+            f"{out['restarts']} restart(s), {acq['queue.cond']} "
+            f"queue.cond + {acq['supervisor.state']} supervisor.state "
+            "acquisitions, zero sanitizer findings")
+
+
 # ------------------------------------------------------------ subprocess
 def _spawn_train(args, env_extra=None):
     env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
@@ -727,6 +1230,12 @@ def main() -> int:
         run("desync", scenario_desync_inproc, tmp)
         run("straggler", scenario_straggler_inproc, tmp)
         run("oom_dispatch", scenario_oom_dispatch_inproc, tmp)
+        # fleet scenarios (ISSUE 19): in-process fast analogs; the
+        # kill is an abrupt listener teardown, the drain is queue-level
+        run("overload_shed", scenario_overload_shed_inproc, tmp, 3)
+        run("serve_drain", scenario_serve_drain_inproc, tmp, 3)
+        run("replica_kill", scenario_replica_kill_inproc, tmp, 3)
+        run("lockcheck_fleet", scenario_lockcheck_fleet, tmp, 3)
     else:
         run("kill_resume", scenario_kill_resume_subproc, tmp, args.trees,
             args.seed)
@@ -750,6 +1259,13 @@ def main() -> int:
         run("desync", scenario_desync_inproc, tmp)
         run("straggler", scenario_straggler_inproc, tmp)
         run("oom_dispatch", scenario_oom_dispatch_inproc, tmp)
+        # fleet scenarios, the real thing: overload is process-local
+        # either way; the drain SIGTERMs a live task=serve process and
+        # the kill SIGKILLs one replica subprocess mid-load
+        run("overload_shed", scenario_overload_shed_inproc, tmp, 3)
+        run("serve_drain", scenario_serve_drain_subproc, tmp, 3)
+        run("replica_kill", scenario_replica_kill_subproc, tmp, 3)
+        run("lockcheck_fleet", scenario_lockcheck_fleet, tmp, 3)
 
     summary = {"mode": "dryrun" if args.dryrun else "subprocess",
                "seed": args.seed, "failures": failures,
