@@ -225,10 +225,28 @@ def run_train(cfg: Config) -> GBDT:
         jax.profiler.start_trace(cfg.profile_dir)
         profiler_ctx = cfg.profile_dir
 
+    # gang membership (resilience/gang.py): when a GangSupervisor
+    # launched us, announce readiness just before the loop starts,
+    # heartbeat every completed iteration, and stamp the rank topology
+    # + barrier ids into every checkpoint manifest
+    from .resilience.gang import beacon_from_env
+
+    beacon = beacon_from_env()
+    gang_block = None
+    heartbeat = None
+    if beacon is not None:
+        gang_block = beacon.gang_block()
+        heartbeat = beacon.heartbeat
+        beacon.ready()
+        if start_iter:
+            beacon.heartbeat(start_iter)
+
     start = time.perf_counter()
     stop_iter = None
     try:
-        with ckpt.CheckpointManager(cfg, booster, best_score, best_iter) as ckmgr:
+        with ckpt.CheckpointManager(cfg, booster, best_score, best_iter,
+                                    gang=gang_block,
+                                    heartbeat=heartbeat) as ckmgr:
             stop_iter = _train_loop(cfg, booster, valid_names, best_score,
                                     best_iter, start, start_iter, ckmgr)
     finally:
@@ -283,6 +301,20 @@ def _write_train_manifest(cfg: Config, booster: GBDT, train_s: float,
         ranks: list = []
         extra: dict = {}
         from .obs import dist
+        from .resilience.gang import beacon_from_env
+
+        beacon = beacon_from_env()
+        if beacon is not None:
+            # gang ranks are independent single-process jax worlds
+            # (redundant data-parallel mode), so the >1-world exchange
+            # below never triggers for them: publish the gang-stamped
+            # snapshot under the formation rank so the supervisor's
+            # train-fleet manifest carries every rank's telemetry
+            # (resilience/gang.py write_train_fleet_artifact)
+            dist.write_rank_snapshot(
+                os.environ.get("LGBM_TPU_RANK_OBS_DIR") or
+                dist.exchange_dir_for(manifest_path(cfg.output_model)),
+                dist.rank_snapshot(rank=beacon.rank, world=beacon.world))
 
         if dist.process_count() > 1:
             xdir = dist.exchange_dir_for(manifest_path(cfg.output_model))
@@ -503,6 +535,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_serve(cfg)
         elif cfg.task == "serve_fleet":
             return run_serve_fleet(cfg)
+        elif cfg.task == "train_fleet":
+            # elastic gang training (resilience/gang.py): supervise
+            # train_ranks rank subprocesses with coordinated checkpoint
+            # barriers and the restart/shrink recovery ladder.  The
+            # supervisor imports no jax — only the children pay for a
+            # device runtime.
+            from .resilience.gang import train_fleet_from_config
+
+            return train_fleet_from_config(cfg)
         else:
             Log.fatal(f"Unknown task: {cfg.task!r}")
     except TrainingPreempted as ex:

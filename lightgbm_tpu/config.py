@@ -330,6 +330,34 @@ class Config:
     # exponential backoff) before failing the whole fleet loudly
     serve_restart_budget: int = 8
 
+    # ---- training gang (task=train_fleet; resilience/gang.py)
+    # rank subprocesses in the training gang
+    train_ranks: int = 2
+    # coordinated checkpoint barrier cadence in boosting iterations;
+    # 0 = inherit snapshot_freq (one of the two must be > 0 for
+    # task=train_fleet — a gang without barriers cannot roll back)
+    gang_barrier_every: int = 0
+    # total gang recoveries (restart or shrink, with jittered
+    # exponential backoff) before the supervisor fails loudly
+    gang_restart_budget: int = 8
+    gang_backoff_base_s: float = 0.2
+    gang_backoff_max_s: float = 5.0
+    # consecutive deaths of ONE rank before the gang stops paying for it
+    # and shrinks (escalation stage 3); same-world restarts below this
+    gang_rank_fail_limit: int = 2
+    # smallest world size the gang may shrink to
+    gang_min_ranks: int = 1
+    # heartbeat staleness (seconds) after which a live-looking rank is
+    # declared hung and SIGKILLed; 0 disables hang detection
+    gang_heartbeat_timeout_s: float = 60.0
+    gang_ready_timeout_s: float = 180.0
+    # shard the data file across ranks (reshard on shrink, gated on
+    # global-histogram parity); false = every rank trains the full data
+    gang_shard_data: bool = False
+    # gang working dir (per-rank models/checkpoints/heartbeats/logs);
+    # default "<output_model>.gang"
+    gang_dir: str = ""
+
     def __post_init__(self):
         if not self.metric:
             self.metric = []
@@ -462,6 +490,26 @@ class Config:
                 "serve_max_replicas must be 0 (off) or >= serve_replicas")
         if self.serve_restart_budget < 0:
             raise ValueError("serve_restart_budget must be >= 0")
+        if self.train_ranks < 1:
+            raise ValueError("train_ranks must be >= 1")
+        if self.gang_barrier_every < 0:
+            raise ValueError("gang_barrier_every must be >= 0")
+        if self.gang_restart_budget < 0:
+            raise ValueError("gang_restart_budget must be >= 0")
+        if self.gang_rank_fail_limit < 1:
+            raise ValueError("gang_rank_fail_limit must be >= 1")
+        if not 1 <= self.gang_min_ranks <= self.train_ranks:
+            raise ValueError(
+                "gang_min_ranks must be in [1, train_ranks]")
+        if self.gang_backoff_base_s <= 0 or \
+                self.gang_backoff_max_s < self.gang_backoff_base_s:
+            raise ValueError(
+                "need gang_backoff_base_s > 0 and "
+                "gang_backoff_max_s >= gang_backoff_base_s")
+        if self.gang_heartbeat_timeout_s < 0:
+            raise ValueError("gang_heartbeat_timeout_s must be >= 0")
+        if self.gang_ready_timeout_s <= 0:
+            raise ValueError("gang_ready_timeout_s must be > 0")
         if not 0.0 <= self.skip_drop <= 1.0:
             raise ValueError("skip_drop must be in [0, 1]")
 

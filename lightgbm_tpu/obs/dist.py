@@ -145,6 +145,17 @@ def rank_snapshot(tel: Optional[telemetry.Telemetry] = None,
         "telemetry": tel.snapshot(include_samples=True),
         "extra": dict(extra or {}),
     }
+    # gang membership (resilience/gang.py): a supervised rank stamps its
+    # slot/gang id so a recovery timeline is attributable — "slot 2's
+    # third incarnation" reads straight off the merged manifest
+    gang_dir = os.environ.get("LGBM_TPU_GANG_DIR", "")
+    if gang_dir:
+        snap["gang"] = {
+            "gang_id": os.environ.get("LGBM_TPU_GANG_ID", "gang"),
+            "slot": int(os.environ.get("LGBM_TPU_GANG_SLOT", "0") or 0),
+            "barrier_every": int(
+                os.environ.get("LGBM_TPU_GANG_BARRIER_EVERY", "0") or 0),
+        }
     # Every rank snapshot carries its own device-memory high-water mark so
     # the merged artifact can show memory skew beside time skew.  The shared
     # reader degrades to the census high-water on backends without allocator
@@ -423,6 +434,8 @@ def ranks_section(snaps: Sequence[dict]) -> List[dict]:
                     (s.get("extra") or {}).get("hbm_peak_bytes"))
         if hbm is not None:
             row["hbm_peak_bytes"] = int(hbm)
+        if s.get("gang"):
+            row["gang"] = dict(s["gang"])
         out.append(row)
     return out
 

@@ -159,6 +159,49 @@ def initialize_from_config(cfg=None) -> bool:
     return False
 
 
+def describe_topology() -> dict:
+    """This process's rank-topology block, for checkpoint manifests and
+    rank telemetry (obs/dist.py): who am I, how wide is the world, and
+    which devices are local.  Resolution mirrors obs/dist.py — the live
+    jax runtime when one is attached, else the launcher env
+    (``LGBM_TPU_PROCESS_ID``/``LGBM_TPU_NUM_PROCESSES``), so a gang
+    supervisor's CPU-only rank children report the same shape a real
+    multihost world would."""
+    topo = {
+        "process_id": int(os.environ.get("LGBM_TPU_PROCESS_ID", "0") or 0),
+        "num_processes": int(
+            os.environ.get("LGBM_TPU_NUM_PROCESSES", "1") or 1),
+        "local_devices": 0,
+        "global_devices": 0,
+        "platform": "",
+    }
+    # only query the live runtime when a backend already exists — the
+    # probe must never initialize XLA as a side effect (that would
+    # break the machine_list_file bootstrap _already_distributed guards)
+    backend_live = False
+    try:
+        from jax._src import xla_bridge
+
+        backend_live = bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001 — private API moved; stay on env
+        backend_live = _already_distributed()
+    if backend_live:
+        try:
+            topo["process_id"] = jax.process_index()
+            topo["num_processes"] = jax.process_count()
+            topo["local_devices"] = jax.local_device_count()
+            topo["global_devices"] = jax.device_count()
+            topo["platform"] = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001 — env fallback already filled in
+            pass
+    gang_dir = os.environ.get("LGBM_TPU_GANG_DIR", "")
+    if gang_dir:
+        topo["gang_id"] = os.environ.get("LGBM_TPU_GANG_ID", "gang")
+        topo["gang_slot"] = int(
+            os.environ.get("LGBM_TPU_GANG_SLOT", "0") or 0)
+    return topo
+
+
 def sync_config_across_processes(cfg) -> None:
     """Cross-process config agreement — the reference's GlobalSyncUpByMin
     (application.cpp:110-127, 190-198, 259-270): randomized-behavior

@@ -44,6 +44,9 @@ from .faults import (  # noqa: F401
 )
 from .retry import (  # noqa: F401
     CollectiveDeadlineExceeded,
+    RecoveryEscalation,
+    RecoveryExhausted,
+    backoff_delay,
     call_with_deadline,
     retry_transient,
 )

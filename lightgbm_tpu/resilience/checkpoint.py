@@ -161,11 +161,19 @@ def _restore_models(groups: List[dict]) -> List:
 def save_checkpoint(path: str, booster, cfg, *, iteration: int,
                     best_score: Optional[Dict[tuple, float]] = None,
                     best_iter: Optional[Dict[tuple, int]] = None,
-                    prev_sha: Optional[str] = None) -> str:
+                    prev_sha: Optional[str] = None,
+                    gang: Optional[dict] = None) -> str:
     """Serialize the full training state after ``iteration`` completed
     boosting iterations.  Reading the device buffers is a deliberate
     host sync (counted); the checkpoint cadence, not the tree loop,
-    pays it."""
+    pays it.
+
+    ``gang`` (optional) is the rank-topology block a gang member stamps
+    into its manifest — ``{gang_id, rank, world_size, barrier_every,
+    barrier_id, barrier}`` — so the gang supervisor can compute the last
+    COORDINATED barrier (an iteration every live rank checkpointed)
+    without trusting filenames alone, and so a resumed rank can refuse a
+    checkpoint written under a different topology."""
     telemetry.host_sync()
     payload: Dict = {
         "schema": SCHEMA,
@@ -212,6 +220,8 @@ def save_checkpoint(path: str, booster, cfg, *, iteration: int,
         },
         "telemetry": telemetry.get_telemetry().snapshot(),
     }
+    if gang is not None:
+        payload["gang"] = dict(gang)
     if hasattr(booster, "_drop_rng"):  # DART extras
         payload["dart"] = {
             "drop_rng": _enc_rng(booster._drop_rng),
@@ -379,7 +389,8 @@ class CheckpointManager:
     due snapshots, and raises :class:`TrainingPreempted` after a
     stop-signal checkpoint."""
 
-    def __init__(self, cfg, booster, best_score: Dict, best_iter: Dict):
+    def __init__(self, cfg, booster, best_score: Dict, best_iter: Dict,
+                 gang: Optional[dict] = None, heartbeat=None):
         self.cfg = cfg
         self.booster = booster
         self.best_score = best_score
@@ -387,6 +398,11 @@ class CheckpointManager:
         self.freq = int(getattr(cfg, "snapshot_freq", 0) or 0)
         self.dir = checkpoint_dir(cfg)
         self.enabled = self.freq > 0
+        # gang membership (resilience/gang.py): static topology stamped
+        # into every checkpoint, plus a liveness beacon the supervisor's
+        # heartbeat deadline watches
+        self.gang = dict(gang) if gang else None
+        self.heartbeat = heartbeat
         self._stop_signum: Optional[int] = None
         self._old_handlers: Dict[int, object] = {}
         self._last_sha: Optional[str] = None
@@ -458,15 +474,35 @@ class CheckpointManager:
                                     completed)
         if self.enabled and completed % self.freq == 0:
             self.write(completed)
+        if self.heartbeat is not None:
+            # the beacon fires AFTER any due barrier commit: a
+            # supervisor-observed heartbeat at K implies K's barrier
+            # checkpoint is durable, so a gang rollback never regresses
+            # past an iteration some rank already attested
+            self.heartbeat(completed)
+        # the hang fault fires AFTER any due checkpoint commits: a
+        # wedged collective strikes between barriers, not instead of
+        # one, so the gang supervisor's rollback lands on the barrier
+        # this iteration just published
+        faults.maybe_hang(completed)  # chaos: may stall (no heartbeat)
 
     def write(self, completed: int) -> Optional[str]:
         if not self.enabled and self._stop_signum is None:
             return None
         os.makedirs(self.dir, exist_ok=True)
         path = checkpoint_file(self.dir, completed)
+        gang_block = None
+        if self.gang is not None:
+            gang_block = dict(self.gang)
+            every = int(gang_block.get("barrier_every", 0) or self.freq or 1)
+            gang_block["barrier_id"] = completed
+            # barrier-aligned writes are the coordinated ones; a SIGTERM
+            # checkpoint can land at any iteration and says so
+            gang_block["barrier"] = (completed % every == 0)
         save_checkpoint(path, self.booster, self.cfg,
                         iteration=completed, best_score=self.best_score,
-                        best_iter=self.best_iter, prev_sha=self._last_sha)
+                        best_iter=self.best_iter, prev_sha=self._last_sha,
+                        gang=gang_block)
         if faults.maybe_corrupt_checkpoint(path):
             Log.warning(f"FAULT corrupt_checkpoint: corrupted {path}")
         self._last_sha = _file_payload_sha(path)

@@ -11,6 +11,12 @@ spec                        injection point
 ``kill_after_tree:K``       cli train loop raises SIGTERM to the process the
                             moment iteration K completes — the real
                             preemption signal through the real handler
+``hang_after_tree:K[:S]``   cli train loop stalls for S seconds (default
+                            3600 — "forever" at test scale) the moment
+                            iteration K completes, without heartbeating —
+                            the lab stand-in for a wedged collective /
+                            dead NIC; the gang supervisor's heartbeat
+                            deadline must detect and kill the rank
 ``corrupt_checkpoint``      every checkpoint write is followed by flipping
                             bytes mid-file — resume must refuse it loudly
 ``nan_grads:J``             gradient poisoning at boosting iteration J
@@ -52,9 +58,10 @@ import os
 import signal
 from typing import Dict, Optional
 
-_VALID = ("kill_after_tree", "corrupt_checkpoint", "nan_grads",
-          "fail_collective_once", "fail_write_once", "corrupt_model",
-          "delay_collective", "desync_step", "oom_dispatch")
+_VALID = ("kill_after_tree", "hang_after_tree", "corrupt_checkpoint",
+          "nan_grads", "fail_collective_once", "fail_write_once",
+          "corrupt_model", "delay_collective", "desync_step",
+          "oom_dispatch")
 
 
 class InjectedFault(Exception):
@@ -150,6 +157,27 @@ def maybe_kill(completed_iterations: int) -> None:
         _consume("kill_after_tree")
         _note("kill_after_tree", iteration=completed_iterations)
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_hang(completed_iterations: int) -> None:
+    """cli train-loop hook: stall this rank for S seconds once iteration
+    K has completed, WITHOUT heartbeating — from the gang supervisor's
+    seat this is indistinguishable from a wedged collective, which is
+    the point: the heartbeat deadline (not a human) must notice and
+    SIGKILL the rank."""
+    p = fault_active("hang_after_tree")
+    if p is None:
+        return
+    k, _, secs = p.partition(":")
+    if completed_iterations != int(k or 0):
+        return
+    _consume("hang_after_tree")
+    stall_s = float(secs) if secs else 3600.0
+    _note("hang_after_tree", iteration=completed_iterations,
+          stall_s=stall_s)
+    import time
+
+    time.sleep(stall_s)
 
 
 def maybe_fail_write(path: str) -> None:

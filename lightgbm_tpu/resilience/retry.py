@@ -60,6 +60,24 @@ def is_transient(exc: BaseException) -> bool:
     return any(marker in msg for marker in TRANSIENT_MARKERS)
 
 
+def backoff_delay(attempt: int, *, base_s: float, max_s: float,
+                  rng=None) -> float:
+    """THE exponential-backoff schedule, shared by every retry loop in
+    the tree (transient-collective retry here, replica restarts in
+    serving/supervisor.py, gang restarts in resilience/gang.py,
+    coordinator connects in parallel/multihost.py).  ``attempt`` is the
+    zero-based failure count: attempt 0 waits ``base_s``.
+
+    With ``rng`` (a ``random.Random``) the delay is jittered into
+    ``[0.5x, 1.5x)`` — fleet restarts must not stampede the coordinator
+    in lockstep.  Without it the schedule is deterministic, which the
+    single-process retry paths prefer (reproducible test timings)."""
+    delay = min(max_s, base_s * (2 ** max(0, attempt)))
+    if rng is not None:
+        delay *= 0.5 + rng.random()  # jitter in [0.5x, 1.5x)
+    return delay
+
+
 def retry_transient(fn: Callable[[], T], *, retries: int = 3,
                     base_delay_s: float = 0.5, max_delay_s: float = 8.0,
                     label: str = "") -> T:
@@ -77,7 +95,8 @@ def retry_transient(fn: Callable[[], T], *, retries: int = 3,
             if not is_transient(e) or attempt >= retries:
                 raise
             attempt += 1
-            delay = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
+            delay = backoff_delay(attempt - 1, base_s=base_delay_s,
+                                  max_s=max_delay_s)
             # attribute the retry to the specific collective/site: the
             # bare global counter says "something retried somewhere",
             # which on an 8-rank run is no attribution at all
@@ -184,3 +203,83 @@ def collective_deadline_s(cfg=None, default: float = 0.0) -> float:
     if cfg is not None:
         return float(getattr(cfg, "collective_deadline_s", default) or 0.0)
     return default
+
+
+# --------------------------------------------------- escalation ladder
+class RecoveryExhausted(RuntimeError):
+    """Every recovery stage has been spent: the restart budget is gone
+    (or shrinking would go below the minimum world size).  The caller
+    must fail LOUDLY — dump the flight recorder and exit nonzero; a
+    supervisor that silently keeps respawning a doomed gang burns fleet
+    capacity without ever telling an operator."""
+
+
+class RecoveryEscalation:
+    """The three-stage recovery ladder for multihost training.
+
+    Stage 1 — **retry** — lives inside the rank: pre-dispatch transient
+    failures are retried in place by :func:`guarded_collective` /
+    :func:`retry_transient`.  A failure that escapes a rank (process
+    death, a fired collective deadline, a heartbeat stall) reaches this
+    object, which decides between the remaining stages:
+
+    Stage 2 — **restart** — abort the iteration, roll every survivor
+    back to the last coordinated checkpoint barrier, and reform the gang
+    at the SAME world size (bitwise-identical resume).  Each restart
+    consumes one unit of ``restart_budget`` and waits a jittered
+    exponential backoff (:func:`backoff_delay`).
+
+    Stage 3 — **shrink** — when the same rank has died
+    ``rank_fail_limit`` times in a row, stop paying for it: drop the
+    rank, reshard the data (gated on global-histogram parity), and
+    reform the gang one rank smaller.  Shrinking also consumes budget.
+
+    When the budget is exhausted, or shrinking would drop the world
+    below ``min_world``, :meth:`next_action` raises
+    :class:`RecoveryExhausted`.
+
+    Decisions are deterministic given ``seed`` (the jitter uses a
+    private ``random.Random``), so chaos tests replay exactly."""
+
+    def __init__(self, *, restart_budget: int = 8, rank_fail_limit: int = 2,
+                 min_world: int = 1, backoff_base_s: float = 0.2,
+                 backoff_max_s: float = 5.0, seed: int = 0) -> None:
+        import random
+
+        self.restart_budget = int(restart_budget)
+        self.rank_fail_limit = int(rank_fail_limit)
+        self.min_world = max(1, int(min_world))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.spent = 0
+        self._rng = random.Random(seed)
+
+    def remaining(self) -> int:
+        return max(0, self.restart_budget - self.spent)
+
+    def next_action(self, *, world: int, rank_failures: int):
+        """Classify the next recovery step after a rank failure.
+
+        ``world`` is the current gang size; ``rank_failures`` is the
+        consecutive-failure count of the slot that just died (including
+        this failure).  Returns ``("restart", delay_s)`` or
+        ``("shrink", delay_s)``; raises :class:`RecoveryExhausted` when
+        the ladder has no rung left."""
+        if self.spent >= self.restart_budget:
+            raise RecoveryExhausted(
+                f"restart budget exhausted ({self.spent}/"
+                f"{self.restart_budget} recoveries spent) — refusing to "
+                "respawn a gang that keeps dying. Inspect the flight "
+                "recorder dump and the per-rank logs; raise "
+                "gang_restart_budget only once the cause is understood.")
+        want_shrink = rank_failures >= self.rank_fail_limit
+        if want_shrink and world - 1 < self.min_world:
+            raise RecoveryExhausted(
+                f"rank died {rank_failures}x (limit {self.rank_fail_limit}) "
+                f"but shrinking below gang_min_ranks={self.min_world} is "
+                "not allowed — the world cannot hold the workload. "
+                "Replace the bad host or lower gang_min_ranks.")
+        self.spent += 1
+        delay = backoff_delay(self.spent - 1, base_s=self.backoff_base_s,
+                              max_s=self.backoff_max_s, rng=self._rng)
+        return ("shrink" if want_shrink else "restart"), delay

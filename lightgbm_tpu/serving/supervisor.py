@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..analysis import lockcheck
 from ..log import Log
 from ..obs import flightrec, telemetry
+from ..resilience import retry
 
 #: consecutive failed health checks before a live process is declared
 #: wedged and restarted anyway
@@ -478,9 +479,9 @@ class ReplicaSupervisor:
             flightrec.dump(reason="fleet_budget_exhausted")
             Log.warning(str(err))
             raise err
-        delay = min(self._backoff_max,
-                    self._backoff_base * (2 ** slot.restart_count))
-        delay *= 0.5 + self._rng.random()  # jitter in [0.5x, 1.5x)
+        delay = retry.backoff_delay(slot.restart_count,
+                                    base_s=self._backoff_base,
+                                    max_s=self._backoff_max, rng=self._rng)
         slot.restart_count += 1
         slot.backoff_history.append(delay)
         kind = "preempted" if rc == 75 else "crashed"

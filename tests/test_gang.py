@@ -1,0 +1,571 @@
+"""Tier-1 gate for elastic multihost training (ISSUE 20):
+
+* barrier math — ``last_common_barrier`` is the newest iteration EVERY
+  rank checkpointed; ``rollback_to_barrier`` prunes uncoordinated
+  progress past it;
+* the reshard parity gate — ``histogram_fingerprint`` is
+  order-independent over the row multiset, ``shard_rows`` refuses a
+  partition that lost or duplicated rows (``GangParityError``);
+* the recovery ladder — ``RecoveryEscalation`` restarts at the same
+  world, shrinks past a repeat offender, and raises
+  ``RecoveryExhausted`` on a spent budget or a floor-breaking shrink;
+  ``backoff_delay`` is THE shared jittered-exponential schedule
+  (serving/supervisor.py and gang recovery use the same function);
+* the supervisor itself — ThreadRank gangs with a deterministic stub
+  job: a chaos kill recovers bitwise at the same world size, SIGTERM
+  fan-out turns into exit 75 on EVERY rank, a doomed gang exhausts its
+  budget LOUDLY (flight-recorder dump, exit 1);
+* the wire format — checkpoints carry the gang topology block,
+  ``beacon_from_env`` round-trips the supervisor's env contract, and
+  ``task=train_fleet`` re-emits training params to rank children;
+* ``tools/benchdiff.py``'s train-fleet kind — failed_iterations>0 and
+  budget exhaustion regress outright, MTTR gates at the phase
+  threshold, cross-kind diffs refuse (exit 2);
+* the committed ``.bench/train_fleet.json`` — the PR's acceptance
+  evidence: a real 4-rank chaos-kill run that recovered with zero
+  failed iterations and passes its own benchdiff gate.
+
+ThreadRank gangs only — real rank subprocesses live in tools/chaos.py
+(rank_kill_midtrain / rank_hang / elastic_shrink) and the slow-marked
+test in test_resilience.py, so this module stays cheap in tier-1.
+"""
+
+import hashlib
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from lightgbm_tpu.obs import flightrec  # noqa: E402
+from lightgbm_tpu.resilience import gang as gang_mod  # noqa: E402
+from lightgbm_tpu.resilience import EXIT_PREEMPTED  # noqa: E402
+from lightgbm_tpu.resilience.gang import (GangParityError,  # noqa: E402
+                                          GangSupervisor, RankBeacon,
+                                          ThreadRank, ThreadRankContext,
+                                          beacon_from_env,
+                                          heartbeat_file,
+                                          histogram_fingerprint,
+                                          last_common_barrier, ready_file,
+                                          rollback_to_barrier, shard_rows)
+from lightgbm_tpu.resilience.retry import (RecoveryEscalation,  # noqa: E402
+                                           RecoveryExhausted,
+                                           backoff_delay)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------- shared backoff
+def test_backoff_delay_schedule_and_jitter():
+    import random
+
+    # deterministic without rng: base * 2^attempt, capped
+    assert backoff_delay(0, base_s=0.2, max_s=5.0) == pytest.approx(0.2)
+    assert backoff_delay(2, base_s=0.2, max_s=5.0) == pytest.approx(0.8)
+    assert backoff_delay(10, base_s=0.2, max_s=5.0) == pytest.approx(5.0)
+    # jitter stays in [0.5x, 1.5x) and is reproducible per seed
+    rng = random.Random(7)
+    vals = [backoff_delay(1, base_s=0.2, max_s=5.0,
+                          rng=random.Random(s)) for s in range(50)]
+    assert all(0.2 <= v < 0.6 for v in vals)
+    assert backoff_delay(1, base_s=0.2, max_s=5.0, rng=rng) == \
+        backoff_delay(1, base_s=0.2, max_s=5.0, rng=random.Random(7))
+
+
+def test_backoff_is_the_shared_helper():
+    """The serving replica supervisor and the gang ladder must use the
+    SAME schedule — the dedup satellite.  Both import the function from
+    retry.py; a reintroduced private copy fails here."""
+    from lightgbm_tpu.serving import supervisor as serving_sup
+
+    assert serving_sup.retry.backoff_delay is backoff_delay
+    import lightgbm_tpu.resilience.retry as retry_mod
+
+    src = open(os.path.join(
+        ROOT, "lightgbm_tpu", "serving", "supervisor.py")).read()
+    assert "retry.backoff_delay(" in src
+    assert retry_mod.backoff_delay is backoff_delay
+
+
+# ---------------------------------------------------- escalation ladder
+def test_escalation_restart_then_shrink():
+    esc = RecoveryEscalation(restart_budget=5, rank_fail_limit=2,
+                             min_world=1, backoff_base_s=0.01,
+                             backoff_max_s=0.05, seed=3)
+    action, delay = esc.next_action(world=4, rank_failures=1)
+    assert action == "restart" and delay > 0
+    action, _ = esc.next_action(world=4, rank_failures=2)
+    assert action == "shrink"
+    assert esc.spent == 2 and esc.remaining() == 3
+
+
+def test_escalation_budget_exhausts_loudly():
+    esc = RecoveryEscalation(restart_budget=2, rank_fail_limit=3,
+                             backoff_base_s=0.01, backoff_max_s=0.02)
+    esc.next_action(world=2, rank_failures=1)
+    esc.next_action(world=2, rank_failures=1)
+    with pytest.raises(RecoveryExhausted, match="budget exhausted"):
+        esc.next_action(world=2, rank_failures=1)
+
+
+def test_escalation_refuses_to_shrink_below_floor():
+    esc = RecoveryEscalation(restart_budget=10, rank_fail_limit=2,
+                             min_world=2, backoff_base_s=0.01,
+                             backoff_max_s=0.02)
+    with pytest.raises(RecoveryExhausted, match="gang_min_ranks"):
+        esc.next_action(world=2, rank_failures=2)
+
+
+# ---------------------------------------------------------- barrier math
+def _mk_ckpts(tmp_path, name, iterations):
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    for it in iterations:
+        with open(os.path.join(d, f"ckpt_{it:08d}.json"), "w") as fh:
+            fh.write("{}")
+    return d
+
+
+def test_last_common_barrier_is_the_intersection_max(tmp_path):
+    d0 = _mk_ckpts(tmp_path, "r0", [2, 4, 6])
+    d1 = _mk_ckpts(tmp_path, "r1", [2, 4])
+    d2 = _mk_ckpts(tmp_path, "r2", [4, 6])
+    assert last_common_barrier([d0, d1, d2]) == 4
+    assert last_common_barrier([d0]) == 6
+    # no intersection -> barrier 0 (scratch restart is a valid barrier)
+    d3 = _mk_ckpts(tmp_path, "r3", [])
+    assert last_common_barrier([d0, d3]) == 0
+
+
+def test_rollback_prunes_uncoordinated_progress(tmp_path):
+    d0 = _mk_ckpts(tmp_path, "r0", [2, 4, 6])
+    d1 = _mk_ckpts(tmp_path, "r1", [2, 4])
+    removed = rollback_to_barrier([d0, d1], 4)
+    assert removed == 1
+    assert sorted(os.listdir(d0)) == ["ckpt_00000002.json",
+                                      "ckpt_00000004.json"]
+    assert last_common_barrier([d0, d1]) == 4
+
+
+# ------------------------------------------------------ parity gate
+def test_histogram_fingerprint_is_order_independent(tmp_path):
+    a = str(tmp_path / "a.csv")
+    b = str(tmp_path / "b.csv")
+    open(a, "w").write("1,2\n3,4\n5,6\n")
+    open(b, "w").write("5,6\n1,2\n3,4\n")
+    assert histogram_fingerprint([a]) == histogram_fingerprint([b])
+    # split across files == one file (partition-invariance)
+    c = str(tmp_path / "c.csv")
+    d = str(tmp_path / "d.csv")
+    open(c, "w").write("3,4\n")
+    open(d, "w").write("5,6\n1,2\n")
+    assert histogram_fingerprint([c, d]) == histogram_fingerprint([a])
+    # losing a row or duplicating one changes the multiset
+    open(d, "w").write("5,6\n")
+    assert histogram_fingerprint([c, d]) != histogram_fingerprint([a])
+    open(d, "w").write("5,6\n1,2\n1,2\n")
+    assert histogram_fingerprint([c, d]) != histogram_fingerprint([a])
+
+
+def test_shard_rows_round_robin_and_gate(tmp_path):
+    src = str(tmp_path / "data.csv")
+    rows = [f"{i},{i * 2},{i * 3}" for i in range(17)]
+    open(src, "w").write("\n".join(rows) + "\n")
+    paths = shard_rows(src, str(tmp_path / "shards"), [0, 1, 2])
+    assert set(paths) == {0, 1, 2}
+    # round-robin: row i lands on slot i % 3, every shard non-empty
+    got0 = open(paths[0]).read().splitlines()
+    assert got0 == rows[0::3]
+    assert histogram_fingerprint(list(paths.values())) == \
+        histogram_fingerprint([src])
+
+
+def test_shard_rows_parity_gate_refuses_row_loss(tmp_path, monkeypatch):
+    """If the shard writer drops a row, the gate must refuse BEFORE
+    anyone trains on the bad partition."""
+    src = str(tmp_path / "data.csv")
+    open(src, "w").write("\n".join(f"{i},x" for i in range(9)) + "\n")
+    real = gang_mod.atomic_write
+
+    def lossy(path, data, **kw):
+        if "shard_r1" in path:  # drop slot 1's first row
+            data = "\n".join(data.splitlines()[1:]) + "\n"
+        return real(path, data, **kw)
+
+    monkeypatch.setattr(gang_mod, "atomic_write", lossy)
+    with pytest.raises(GangParityError, match="parity gate"):
+        shard_rows(src, str(tmp_path / "shards"), [0, 1, 2])
+
+
+# ---------------------------------------------------------- wire format
+def test_beacon_from_env_round_trip(tmp_path, monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_GANG_DIR", raising=False)
+    assert beacon_from_env() is None
+    gdir = str(tmp_path)
+    monkeypatch.setenv("LGBM_TPU_GANG_DIR", gdir)
+    monkeypatch.setenv("LGBM_TPU_GANG_SLOT", "3")
+    monkeypatch.setenv("LGBM_TPU_PROCESS_ID", "1")
+    monkeypatch.setenv("LGBM_TPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv("LGBM_TPU_GANG_ID", "gang-test")
+    monkeypatch.setenv("LGBM_TPU_GANG_BARRIER_EVERY", "2")
+    b = beacon_from_env()
+    assert (b.slot, b.rank, b.world, b.barrier_every) == (3, 1, 4, 2)
+    block = b.gang_block()
+    assert block["schema"] == gang_mod.GANG_SCHEMA
+    assert block["gang_id"] == "gang-test" and block["slot"] == 3
+
+    b.ready()
+    b.heartbeat(5)
+    with open(ready_file(gdir, 3)) as fh:
+        assert json.load(fh)["pid"] == os.getpid()
+    with open(heartbeat_file(gdir, 3)) as fh:
+        hb = json.load(fh)
+    assert hb["iteration"] == 5 and hb["rank"] == 1
+
+
+def test_checkpoint_carries_gang_topology(tmp_path):
+    """Every gang checkpoint carries the rank-topology block + barrier
+    id, the manifest extension the supervisor's barrier math and a
+    post-mortem reader both rely on."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_resilience import _mini_booster
+
+    from lightgbm_tpu.resilience import checkpoint as ck
+
+    cfg, _, booster = _mini_booster()
+    booster.train_one_iter()
+    beacon = RankBeacon(str(tmp_path), slot=2, rank=1, world=4,
+                        gang_id="g1", barrier_every=2)
+    path = str(tmp_path / "ckpt_00000001.json")
+    block = dict(beacon.gang_block())
+    block["barrier_id"] = 1
+    block["barrier"] = False
+    ck.save_checkpoint(path, booster, cfg, iteration=1, gang=block)
+    payload = ck.load_checkpoint(path)
+    g = payload["gang"]
+    assert g["schema"] == gang_mod.GANG_SCHEMA
+    assert g["slot"] == 2 and g["rank"] == 1 and g["world_size"] == 4
+    assert g["barrier_id"] == 1 and g["barrier"] is False
+
+
+def test_passthrough_params_re_emit_training_knobs():
+    from lightgbm_tpu.config import Config
+
+    cfg = Config(task="train_fleet", data="d.csv", output_model="m.txt",
+                 objective="binary", num_iterations=12, num_leaves=31,
+                 learning_rate=0.05, train_ranks=4, gang_barrier_every=2,
+                 serve_port=9999)
+    out = gang_mod._passthrough_params(cfg)
+    assert "objective=binary" in out
+    assert "num_iterations=12" in out
+    assert "learning_rate=0.05" in out
+    joined = " ".join(out)
+    # supervisor-owned and serving knobs never leak into rank argv
+    for banned in ("task=", "data=", "output_model=", "train_ranks=",
+                   "gang_", "serve_"):
+        assert banned not in joined, joined
+
+
+def test_chaos_kill_env_parsing(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_GANG_CHAOS_KILL", "1:3, 2:5:always")
+    assert gang_mod._chaos_kill_from_env() == {1: (3, False),
+                                               2: (5, True)}
+    monkeypatch.setenv("LGBM_TPU_GANG_CHAOS_KILL", "")
+    assert gang_mod._chaos_kill_from_env() == {}
+    monkeypatch.setenv("LGBM_TPU_GANG_FAULT", "2:hang_after_tree:4:600")
+    assert gang_mod._gang_fault_env() == {2: "hang_after_tree:4:600"}
+
+
+def test_describe_topology_reads_gang_env(monkeypatch):
+    from lightgbm_tpu.parallel import multihost
+
+    monkeypatch.setenv("LGBM_TPU_GANG_DIR", "/tmp/x")
+    monkeypatch.setenv("LGBM_TPU_GANG_ID", "gang-42")
+    monkeypatch.setenv("LGBM_TPU_GANG_SLOT", "2")
+    topo = multihost.describe_topology()
+    for key in ("process_id", "num_processes", "platform"):
+        assert key in topo
+    assert topo["gang_id"] == "gang-42" and topo["gang_slot"] == 2
+    monkeypatch.delenv("LGBM_TPU_GANG_DIR")
+    assert "gang_id" not in multihost.describe_topology()
+
+
+# ------------------------------------------------ ThreadRank supervisor
+def _stub_job(trees, every, die_slot=None, die_at=None):
+    """Deterministic hash-chain job (same shape tools/chaos.py uses):
+    state depends only on the iteration count, so any world size /
+    resume point converges bitwise."""
+
+    def job(ctx):
+        ckpt = os.path.join(ctx.slot_dir, "ckpt")
+        os.makedirs(ckpt, exist_ok=True)
+        start, state = 0, "genesis"
+        if ctx.resume:
+            its = sorted(int(f[5:13]) for f in os.listdir(ckpt)
+                         if f.startswith("ckpt_"))
+            if its:
+                with open(os.path.join(
+                        ckpt, f"ckpt_{its[-1]:08d}.json")) as fh:
+                    rec = json.load(fh)
+                start, state = int(rec["iteration"]), rec["state"]
+        ctx.ready()
+        for it in range(start, trees):
+            ctx.check_signals()
+            time.sleep(0.005)
+            done = it + 1
+            state = hashlib.sha256(
+                f"{state}:{done}".encode()).hexdigest()
+            if die_slot == ctx.slot and done == die_at:
+                raise RuntimeError("injected death")
+            if done % every == 0:
+                from lightgbm_tpu.resilience.atomic import atomic_write_json
+
+                atomic_write_json(
+                    os.path.join(ckpt, f"ckpt_{done:08d}.json"),
+                    {"iteration": done, "state": state})
+            ctx.heartbeat(done)
+        with open(os.path.join(ctx.slot_dir, "model.txt"), "w") as fh:
+            fh.write(state + "\n")
+
+    return job
+
+
+def _mk_supervisor(gdir, slots, job, every=2, **kw):
+    os.makedirs(gdir, exist_ok=True)
+
+    def ckpt_dir_for(s):
+        return os.path.join(gdir, f"r{s}", "ckpt")
+
+    def factory(slot, rank, world, resume):
+        sdir = os.path.join(gdir, f"r{slot}")
+        os.makedirs(ckpt_dir_for(slot), exist_ok=True)
+        ctx = ThreadRankContext(slot, rank, world, gdir, sdir, every,
+                                resume)
+        return ThreadRank(slot, rank, job, ctx)
+
+    defaults = dict(restart_budget=4, rank_fail_limit=2, min_ranks=1,
+                    backoff_base_s=0.01, backoff_max_s=0.02,
+                    heartbeat_timeout_s=10.0, ready_timeout_s=30.0,
+                    poll_interval_s=0.003)
+    defaults.update(kw)
+    return GangSupervisor(factory, slots=list(slots), gang_dir=gdir,
+                          ckpt_dir_for=ckpt_dir_for, barrier_every=every,
+                          **defaults)
+
+
+def _model(gdir, slot=0):
+    with open(os.path.join(gdir, f"r{slot}", "model.txt")) as fh:
+        return fh.read()
+
+
+def test_gang_chaos_kill_recovers_bitwise(tmp_path):
+    base = str(tmp_path / "base")
+    sup = _mk_supervisor(base, [0, 1], _stub_job(8, 2), every=2)
+    assert sup.run() == 0 and sup.recoveries == []
+    want = _model(base)
+
+    gdir = str(tmp_path / "chaos")
+    flightrec.set_dump_dir(gdir)
+    sup = _mk_supervisor(gdir, [0, 1], _stub_job(8, 2), every=2,
+                         chaos_kill_at={1: 3})
+    assert sup.run() == 0
+    assert sup.rank_deaths == 1 and sup.restarts == 1
+    assert sup.shrinks == 0
+    rec = sup.recoveries[0]
+    assert rec["action"] == "restart" and rec["mttr_s"] > 0
+    assert _model(gdir) == want
+    d = sup.describe()
+    assert d["world_size"] == 2 and d["budget_spent"] == 1
+
+
+def test_gang_shrinks_past_repeat_offender(tmp_path):
+    base = str(tmp_path / "base")
+    sup = _mk_supervisor(base, [0, 1, 2], _stub_job(8, 2), every=2)
+    assert sup.run() == 0
+    want = _model(base)
+
+    gdir = str(tmp_path / "shrink")
+    flightrec.set_dump_dir(gdir)
+    sup = _mk_supervisor(gdir, [0, 1, 2],
+                         _stub_job(8, 2, die_slot=2, die_at=4), every=2)
+    assert sup.run() == 0
+    assert sup.shrinks == 1 and sup.active_slot_ids() == [0, 1]
+    assert [r["action"] for r in sup.recoveries] == ["restart", "shrink"]
+    # redundant mode: survivors resumed from the barrier, still bitwise
+    assert _model(gdir) == want
+    assert sup.artifact_section()["world_size_end"] == 2
+
+
+def test_gang_budget_exhausts_with_postmortem(tmp_path):
+    """A doomed gang (its only extra rank dies instantly, shrinking is
+    floored) must exit 1 LOUDLY with a flight-recorder dump — not spin."""
+    gdir = str(tmp_path / "doomed")
+    flightrec.set_dump_dir(gdir)
+    flightrec.reset()
+    sup = _mk_supervisor(gdir, [0, 1],
+                         _stub_job(8, 2, die_slot=1, die_at=1), every=2,
+                         restart_budget=2, rank_fail_limit=99,
+                         min_ranks=2)
+    assert sup.run() == 1
+    assert sup.budget_exhausted is True
+    dumps = [f for f in os.listdir(gdir) if f.startswith("flightrec_")
+             and f.endswith(".json")]
+    assert dumps, "budget exhaustion left no post-mortem"
+    with open(os.path.join(gdir, max(
+            dumps, key=lambda f: os.path.getmtime(
+                os.path.join(gdir, f))))) as fh:
+        rec = json.load(fh)
+    assert rec["reason"] == "gang_budget_exhausted"
+
+
+def test_gang_preempt_fans_out_to_every_rank(tmp_path):
+    """The SIGTERM fan-out satellite: one preemption request turns into
+    terminate() on EVERY live rank; each checkpoints and exits 75 and
+    the supervisor itself reports 75."""
+    gdir = str(tmp_path / "preempt")
+    flightrec.set_dump_dir(gdir)
+
+    def job(ctx):
+        ctx.ready()
+        for it in range(1000):
+            try:
+                ctx.check_signals()
+            except gang_mod.RankPreempted:
+                # the real train loop checkpoints before exit 75
+                os.makedirs(os.path.join(ctx.slot_dir, "ckpt"),
+                            exist_ok=True)
+                raise
+            ctx.heartbeat(it + 1)
+            time.sleep(0.005)
+
+    sup = _mk_supervisor(gdir, [0, 1, 2], job, every=2)
+    handles = []
+    real_factory = sup._factory
+
+    def spying_factory(*a):
+        h = real_factory(*a)
+        handles.append(h)
+        return h
+
+    sup._factory = spying_factory
+    t = threading.Thread(target=lambda: results.append(sup.run()))
+    results: list = []
+    t.start()
+    deadline = time.monotonic() + 30
+    while len(handles) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.1)
+    sup.request_preempt()
+    t.join(30)
+    assert results == [EXIT_PREEMPTED]
+    assert sup.preempted is True
+    assert [h.poll() for h in handles] == [EXIT_PREEMPTED] * 3
+
+
+def test_formation_death_enters_recovery_ladder(tmp_path):
+    """A rank that dies before becoming ready is a recovery, not a
+    crash: the supervisor re-enters the ladder (and here exhausts it,
+    because the rank ALWAYS dies at startup)."""
+    gdir = str(tmp_path / "stillborn")
+    flightrec.set_dump_dir(gdir)
+    calls = {"n": 0}
+
+    def job(ctx):
+        if ctx.slot == 1:
+            calls["n"] += 1
+            raise RuntimeError("dies before ready")
+        ctx.ready()
+        time.sleep(0.01)
+
+    sup = _mk_supervisor(gdir, [0, 1], job, every=2, restart_budget=2,
+                         rank_fail_limit=99, min_ranks=2)
+    assert sup.run() == 1
+    assert sup.budget_exhausted is True
+    assert calls["n"] >= 3  # initial formation + both budgeted retries
+
+
+# -------------------------------------------------- benchdiff + artifact
+def _fleet_art(tmp_path, name, **over):
+    tf = {"world_size_start": 4, "world_size_end": 4, "restarts": 1,
+          "shrinks": 0, "rank_deaths": 1, "rank_hangs": 0,
+          "recoveries": 1, "recovery_timeline": [], "mttr_s": 2.0,
+          "lost_iterations": 1, "budget_spent": 1,
+          "budget_exhausted": False, "preempted": False,
+          "final_barrier": 12, "target_iterations": 12,
+          "failed_iterations": 0, "exit_code": 0,
+          "barriers_committed": 6, "wall_s": 30.0}
+    tf.update(over)
+    path = str(tmp_path / name)
+    with open(path, "w") as fh:
+        json.dump({"schema": "lightgbm-tpu/train-fleet/v1",
+                   "created_unix": 1.0,
+                   "shape": {"ranks": 4, "trees": 12, "barrier_every": 2,
+                             "shard_data": False, "seed": 0},
+                   "train_fleet": tf, "counters": {}}, fh)
+    return path
+
+
+def test_benchdiff_train_fleet_normalize_and_gates(tmp_path):
+    bd = _load_tool("benchdiff")
+    old = _fleet_art(tmp_path, "old.json")
+    rec = bd.normalize(old)
+    assert rec["kind"] == "train_fleet"
+    assert rec["value"] == pytest.approx(2.0)
+    assert bd.main([old, old]) == 0
+
+    # failed iterations are an outright regression
+    bad = _fleet_art(tmp_path, "failed.json", failed_iterations=3,
+                     exit_code=1)
+    assert bd.main([old, bad]) == 1
+    # budget exhaustion regresses
+    exhausted = _fleet_art(tmp_path, "exhausted.json",
+                           budget_exhausted=True)
+    assert bd.main([old, exhausted]) == 1
+    # MTTR blowing past the phase threshold regresses; within it passes
+    slow = _fleet_art(tmp_path, "slow.json", mttr_s=6.0)
+    assert bd.main([old, slow, "--phase-threshold", "25"]) == 1
+    assert bd.main([old, slow, "--phase-threshold", "400"]) == 0
+
+
+def test_benchdiff_train_fleet_refuses_cross_kind(tmp_path):
+    bd = _load_tool("benchdiff")
+    fleet = _fleet_art(tmp_path, "tf.json")
+    serving = os.path.join(ROOT, ".bench", "serving_fleet.json")
+    assert bd.main([fleet, serving]) == 2
+    assert bd.main([serving, fleet]) == 2
+
+
+def test_committed_train_fleet_artifact():
+    """The committed .bench/train_fleet.json is the PR's acceptance
+    evidence: a REAL 4-rank chaos-kill run that recovered with zero
+    failed iterations, a non-trivial MTTR, and a recovery timeline."""
+    path = os.path.join(ROOT, ".bench", "train_fleet.json")
+    with open(path) as fh:
+        art = json.load(fh)
+    assert art["schema"] == "lightgbm-tpu/train-fleet/v1"
+    tf = art["train_fleet"]
+    assert tf["failed_iterations"] == 0
+    assert tf["exit_code"] == 0
+    assert tf["recoveries"] >= 1 and tf["mttr_s"] > 0
+    assert tf["recovery_timeline"], "no recovery timeline"
+    assert tf["world_size_start"] == 4
+    assert art["counters"].get("lgbm_gang_rank_deaths", 0) >= 1
+    assert os.path.exists(os.path.join(
+        ROOT, ".bench", "train_fleet.manifest.json"))
+    bd = _load_tool("benchdiff")
+    rec = bd.normalize(path)
+    assert rec["kind"] == "train_fleet"
+    # the committed artifact passes its own gate (the baseline the next
+    # PR's elastic-training run will diff against)
+    assert bd.main([path, path]) == 0

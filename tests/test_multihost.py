@@ -201,3 +201,44 @@ def test_eight_process_injected_desync_detected_and_named(tmp_path):
     assert dumps, "no flight-recorder dumps from the desync"
     tagged = {f.split("_")[1] for f in dumps}
     assert len(tagged) == len(dumps), f"rank-tag collision: {dumps}"
+
+
+def test_sharded_gang_trains_on_real_partitions(tmp_path):
+    """ISSUE 20 elastic mode on a capable host: ``task=train_fleet``
+    with ``gang_shard_data=true`` round-robins the row file across rank
+    subprocesses behind the histogram parity gate, every rank publishes
+    a gang-stamped telemetry snapshot, and the supervisor's train-fleet
+    manifest carries the full rank topology."""
+    import json
+
+    import numpy as np
+
+    rng = np.random.RandomState(8)
+    X = rng.randn(300, 6)
+    y = (X[:, 0] + 0.3 * rng.randn(300) > 0).astype(np.float64)
+    data = str(tmp_path / "data.csv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter=",")
+    model = str(tmp_path / "model.txt")
+    gdir = str(tmp_path / "gang")
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train_fleet",
+         f"data={data}", "objective=binary", "num_trees=6",
+         "num_leaves=7", "min_data_in_leaf=5",
+         "is_save_binary_file=false", f"output_model={model}",
+         "train_ranks=2", "snapshot_freq=2", f"gang_dir={gdir}",
+         "gang_shard_data=true"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert os.path.exists(model)
+    art = json.load(open(os.path.join(gdir, "train_fleet.json")))
+    tf = art["train_fleet"]
+    assert tf["failed_iterations"] == 0
+    assert art["shape"]["shard_data"] is True
+    assert art["counters"].get("lgbm_gang_parity_checks", 0) >= 1
+    man = json.load(open(os.path.join(gdir,
+                                      "train_fleet.manifest.json")))
+    ranks = man["ranks"]
+    assert len(ranks) == 2, ranks
+    assert sorted(r["gang"]["slot"] for r in ranks) == [0, 1]

@@ -504,7 +504,8 @@ def test_chaos_dryrun_smoke():
         "kill_resume", "corrupt", "fail_write", "nan_grads", "collective",
         "serve_swap", "serve_fail_write", "lockcheck_swap", "desync",
         "straggler", "oom_dispatch", "overload_shed", "serve_drain",
-        "replica_kill", "lockcheck_fleet"}
+        "replica_kill", "lockcheck_fleet", "rank_kill_midtrain",
+        "rank_hang", "elastic_shrink", "lockcheck_gang"}
     # ISSUE 14: the preemption and refused-swap scenarios now also
     # assert a flight-recorder post-mortem (atomic + checksum sidecar,
     # tail = the triggering event) — pinned via the scenario details so
@@ -555,6 +556,28 @@ def test_chaos_dryrun_smoke():
         summary["results"]["lockcheck_fleet"]["detail"]
     assert "supervisor.state acquisitions" in \
         summary["results"]["lockcheck_fleet"]["detail"]
+    # ISSUE 20: the training-gang scenarios pin bitwise recovery from a
+    # mid-train rank kill (zero failed iterations), the heartbeat
+    # deadline converting a hang into a rollback, the shrink rung of the
+    # escalation ladder plus the reshard parity gate refusing a tampered
+    # shard, and the gang supervisor staying silent under the runtime
+    # lock sanitizer while its state lock saw real traffic
+    assert "bitwise-identical model" in \
+        summary["results"]["rank_kill_midtrain"]["detail"]
+    assert "0 failed" in \
+        summary["results"]["rank_kill_midtrain"]["detail"]
+    assert "heartbeat deadline fired" in \
+        summary["results"]["rank_hang"]["detail"]
+    assert "bitwise-identical model" in \
+        summary["results"]["rank_hang"]["detail"]
+    assert "shrink 4->3" in \
+        summary["results"]["elastic_shrink"]["detail"]
+    assert "rejects a tampered shard" in \
+        summary["results"]["elastic_shrink"]["detail"]
+    assert "zero sanitizer findings" in \
+        summary["results"]["lockcheck_gang"]["detail"]
+    assert "gang.state acquisitions" in \
+        summary["results"]["lockcheck_gang"]["detail"]
 
 
 @pytest.mark.slow
@@ -576,11 +599,14 @@ def test_chaos_subprocess_random_kill():
 @pytest.mark.slow
 def test_chaos_subprocess_fleet_kill_and_drain():
     """The real fleet faults: SIGKILL one replica SUBPROCESS of a
-    supervised fleet under live load (zero requests may fail), and
-    SIGTERM a live task=serve process (drain, exit 75, flightrec
-    dump)."""
+    supervised fleet under live load (zero requests may fail), SIGTERM
+    a live task=serve process (drain, exit 75, flightrec dump), and
+    SIGKILL one rank SUBPROCESS of a 4-rank training gang mid-train
+    (rollback to the coordinated barrier, bitwise-identical model)."""
     for scenario, pin in (("replica_kill", "ZERO failed"),
-                          ("serve_drain", "exit 75")):
+                          ("serve_drain", "exit 75"),
+                          ("rank_kill_midtrain",
+                           "bitwise-identical model")):
         r = subprocess.run(
             [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
              "--scenario", scenario],

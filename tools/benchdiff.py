@@ -43,6 +43,20 @@ Accepted input formats (auto-detected per file):
   where growth past an absolute floor plus the phase threshold is a
   protection regression.  Fleet artifacts are never cross-compared
   with any other kind (exit 2).
+* train fleet artifacts   (``.bench/train_fleet.json`` —
+  ``lightgbm-tpu/train-fleet/v1`` from ``task=train_fleet`` /
+  ``tools/chaos.py rank_kill_midtrain``, resilience/gang.py): the
+  headline is MEAN TIME TO RECOVER — detection of a rank death/hang to
+  the reformed gang's last ready handshake — gated at the phase
+  threshold (recovery includes jittered backoff, so it is noisier than
+  a steady-state latency) and only when BOTH runs actually recovered
+  from something; gates that are never perf tradeoffs: any failed
+  iteration (the run ended short of its target) is a regression
+  outright, as is an exhausted restart budget; lost iterations growing
+  at the same barrier cadence is a rollback-quality regression.  World
+  shapes must match (exit 2 — recovery across different rank counts is
+  not comparable), and train-fleet artifacts are never cross-compared
+  with any other kind (exit 2).
 * forest bench artifacts  (``.bench/forest_sweep.json`` —
   ``lightgbm-tpu/forest-bench/v1`` from tools/bench_forest.py):
   headline is the batched forest wall (ONE program advancing all N
@@ -86,6 +100,7 @@ SERVING_SCHEMA = "lightgbm-tpu/serving-bench/v1"
 MULTICHIP_SCHEMA = "lightgbm-tpu/multichip-bench/v1"
 FOREST_SCHEMA = "lightgbm-tpu/forest-bench/v1"
 FLEET_SCHEMA = "lightgbm-tpu/serving-fleet/v1"
+TRAIN_FLEET_SCHEMA = "lightgbm-tpu/train-fleet/v1"
 # shed-rate noise floor (absolute fraction of offered requests): below
 # this, a shed-rate delta at flat load is sampling noise, not a signal
 FLEET_SHED_ABS = 0.02
@@ -185,6 +200,32 @@ def _normalize_fleet(raw: dict, rec: dict) -> dict:
     return rec
 
 
+def _normalize_train_fleet(raw: dict, rec: dict) -> dict:
+    """Train-fleet recovery artifacts (resilience/gang.py): headline is
+    mean-time-to-recover; the recovery ladder's tallies (restarts,
+    shrinks, lost/failed iterations, budget spend) ride in ``aux`` for
+    the train-fleet-specific gates.  Unlike every other kind an
+    mttr_s of 0 is a VALID headline — a run that never needed to
+    recover (the uninterrupted baseline) is the best possible result,
+    not an unusable record."""
+    f = dict(raw.get("train_fleet") or {})
+    rec["kind"] = "train_fleet"
+    rec["value"] = float(f.get("mttr_s") or 0.0)
+    rec["unit"] = "s mttr"
+    rec["aux"] = {k: f.get(k) for k in
+                  ("world_size_start", "world_size_end", "restarts",
+                   "shrinks", "rank_deaths", "rank_hangs", "recoveries",
+                   "lost_iterations", "failed_iterations",
+                   "target_iterations", "budget_spent",
+                   "budget_exhausted", "preempted", "final_barrier",
+                   "barriers_committed", "exit_code", "wall_s")
+                  if f.get(k) is not None}
+    rec["recovery_timeline"] = list(f.get("recovery_timeline") or [])
+    rec["shape"] = raw.get("shape") or {}
+    rec["counters"] = raw.get("counters") or {}
+    return rec
+
+
 def _normalize_multichip(raw: dict, rec: dict) -> dict:
     """Multichip artifacts: headline from ``result.value``; the skew
     tables (span + reservoir, already ``{name: {max_minus_min_s, ...}}``)
@@ -230,6 +271,8 @@ def normalize(path: str) -> dict:
     raw = _load(path)
     rec: dict = {"label": os.path.basename(path), "path": path,
                  "phases": {}, "sha": None, "kind": "training"}
+    if raw.get("schema") == TRAIN_FLEET_SCHEMA:
+        return _normalize_train_fleet(raw, rec)
     if raw.get("schema") == FLEET_SCHEMA:
         return _normalize_fleet(raw, rec)
     if raw.get("schema") == FOREST_SCHEMA:
@@ -495,6 +538,84 @@ def diff_fleet(old: dict, new: dict,
             "warnings": warnings, "improvements": improvements}
 
 
+def diff_train_fleet(old: dict, new: dict,
+                     headline_pct: float = HEADLINE_PCT,
+                     phase_pct: float = PHASE_PCT) -> dict:
+    """Train-fleet recovery comparison.  The headline is
+    mean-time-to-recover, gated at ``phase_pct`` (recovery spans a
+    jittered backoff plus process relaunch, so it is noisier than a
+    steady-state measurement) and only when BOTH runs actually
+    recovered from something — a chaos run against an uninterrupted
+    baseline has no MTTR to diff, only its correctness gates.  Those
+    gates are never perf tradeoffs: ANY failed iteration means the run
+    ended short of its training target (the gang lost work a rollback
+    was supposed to save); an exhausted restart budget means the gang
+    crash-looped to death; lost iterations growing past the phase
+    threshold at the same barrier cadence means rollbacks landed
+    further from the failure than they used to."""
+    regressions, warnings, improvements = [], [], []
+    oa, na = old.get("aux") or {}, new.get("aux") or {}
+    osh, nsh = old.get("shape") or {}, new.get("shape") or {}
+    if osh and nsh and (osh.get("ranks"), osh.get("barrier_every")) != \
+            (nsh.get("ranks"), nsh.get("barrier_every")):
+        raise ValueError(
+            f"train-fleet shapes differ (old: {osh}, new: {nsh}) — "
+            "recovery across different rank counts / barrier cadences "
+            "is not comparable")
+    ov, nv = float(old.get("value") or 0), float(new.get("value") or 0)
+    headline = {"old": ov, "new": nv, "unit": new.get("unit", "s mttr"),
+                "delta_pct": None}
+    if ov > 0 and nv > 0:
+        head = _pct(ov, nv)
+        headline["delta_pct"] = round(head, 1)
+        if head >= phase_pct:
+            regressions.append(
+                f"mean time to recover {ov:.4g} -> {nv:.4g} s "
+                f"(+{head:.1f}%, threshold +{phase_pct:.0f}%)")
+        elif head <= -phase_pct:
+            improvements.append(
+                f"mean time to recover {ov:.4g} -> {nv:.4g} s "
+                f"({head:.1f}%)")
+    elif (ov > 0) != (nv > 0):
+        side = "old" if ov > 0 else "new"
+        warnings.append(
+            f"only the {side} run recovered from anything "
+            f"({oa.get('recoveries', 0)} vs {na.get('recoveries', 0)} "
+            "recoveries) — no MTTR to diff, correctness gates only")
+
+    # correctness gates: these are never perf tradeoffs
+    if int(na.get("failed_iterations") or 0) > 0:
+        regressions.append(
+            f"NEW run FAILED {na['failed_iterations']} iteration(s) "
+            f"(reached barrier {na.get('final_barrier')} of "
+            f"{na.get('target_iterations')}) — the gang lost training "
+            "work a rollback was supposed to save")
+    if na.get("budget_exhausted"):
+        regressions.append(
+            "NEW run exhausted its restart budget "
+            f"(spent {na.get('budget_spent')}) — the gang crash-looped "
+            "to death instead of finishing")
+    ol = int(oa.get("lost_iterations") or 0)
+    nl = int(na.get("lost_iterations") or 0)
+    if nl > ol and (ol == 0 or _pct(ol, nl) >= phase_pct):
+        regressions.append(
+            f"lost_iterations {ol} -> {nl} at the same barrier cadence "
+            "— rollbacks land further from the failure than they "
+            "used to")
+    elif ol > nl:
+        improvements.append(f"lost_iterations {ol} -> {nl}")
+    if int(na.get("world_size_end") or 0) < \
+            int(na.get("world_size_start") or 0):
+        warnings.append(
+            f"NEW run shrank its gang "
+            f"({na.get('world_size_start')} -> "
+            f"{na.get('world_size_end')} ranks, "
+            f"{na.get('shrinks')} shrink(s)) — it finished, but on "
+            "fewer hosts than it was given")
+    return {"headline": headline, "regressions": regressions,
+            "warnings": warnings, "improvements": improvements}
+
+
 def diff_forest(old: dict, new: dict,
                 headline_pct: float = HEADLINE_PCT,
                 phase_pct: float = PHASE_PCT) -> dict:
@@ -679,6 +800,15 @@ def diff(old: dict, new: dict, headline_pct: float = HEADLINE_PCT,
     """Compare two normalized records; returns
     ``{regressions: [...], warnings: [...], improvements: [...],
     headline: {...}}``."""
+    if "train_fleet" in (old.get("kind"), new.get("kind")):
+        if old.get("kind") != new.get("kind"):
+            raise ValueError(
+                f"{old['label']} is a {old.get('kind')} artifact, "
+                f"{new['label']} is a {new.get('kind')} artifact — "
+                "train-fleet recovery metrics and other results are "
+                "not comparable (an MTTR has no meaning against a "
+                "latency or s/tree headline)")
+        return diff_train_fleet(old, new, headline_pct, phase_pct)
     if "fleet" in (old.get("kind"), new.get("kind")):
         if old.get("kind") != new.get("kind"):
             raise ValueError(
@@ -861,6 +991,12 @@ def main(argv: Optional[list] = None) -> int:
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta}) at num_models="
               f"{h.get('num_models')}")
+    elif new.get("kind") == "train_fleet":
+        aux = new.get("aux") or {}
+        print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
+              f"{h['unit']} ({delta}) over "
+              f"{aux.get('recoveries', 0)} recovery(ies), "
+              f"{aux.get('lost_iterations', 0)} lost iteration(s)")
     elif new.get("kind") in ("serving", "fleet"):
         print(f"  headline: {h['old']:.4g} -> {h['new']:.4g} "
               f"{h['unit']} ({delta})")
@@ -874,7 +1010,7 @@ def main(argv: Optional[list] = None) -> int:
     for i in report["improvements"]:
         print(f"  improvement: {i}")
     if new.get("kind") not in ("serving", "multichip", "forest",
-                               "fleet"):
+                               "fleet", "train_fleet"):
         print("  driver-config row (paste into the commit message):")
         print("  " + driver_row(new))
 

@@ -62,6 +62,29 @@ status; the fault matrix lives in docs/resilience.md):
   kill-restart cycle must produce ZERO sanitizer findings while the
   instrumented locks (queue.cond, supervisor.state) demonstrably saw
   traffic.
+* ``rank_kill_midtrain`` — kill one rank of a 4-rank training gang
+  mid-iteration (resilience/gang.py GangSupervisor): the supervisor
+  aborts the iteration, rolls EVERY survivor back to the last
+  coordinated checkpoint barrier, reforms the gang at the same world
+  size, and the final model is BITWISE identical to an uninterrupted
+  run with a recovery timeline (mttr_s > 0) in the train-fleet/v1
+  artifact and zero failed iterations.  The subprocess variant is the
+  ISSUE 20 acceptance run: real ``task=train_fleet`` with 4 rank
+  subprocesses, a benchdiff MTTR gate over the committed
+  ``.bench/train_fleet.json``.
+* ``rank_hang`` — one rank stalls without heartbeating
+  (``hang_after_tree`` fault in the subprocess variant); the
+  supervisor's heartbeat deadline declares it hung, kills it, and the
+  same rollback/reform path restores a bitwise-identical final model.
+* ``elastic_shrink`` — one slot dies PERSISTENTLY (every incarnation);
+  after ``gang_rank_fail_limit`` failures the ladder's third rung
+  shrinks the gang past it, survivors resume from the barrier
+  (redundant mode -> still bitwise), and the shard-mode reshard parity
+  gate (``histogram_fingerprint``) provably rejects a tampered shard.
+* ``lockcheck_gang`` — the gang supervisor under the runtime lock
+  sanitizer (LGBM_TPU_LOCKCHECK=1, fresh process): a full
+  kill-recover-finish cycle must produce ZERO findings while the
+  instrumented ``gang.state`` lock demonstrably saw traffic.
 
 Modes:
 
@@ -101,7 +124,8 @@ SCENARIOS = ("kill_resume", "corrupt", "fail_write", "nan_grads",
              "collective", "serve_swap", "serve_fail_write",
              "lockcheck_swap", "desync", "straggler", "oom_dispatch",
              "overload_shed", "serve_drain", "replica_kill",
-             "lockcheck_fleet")
+             "lockcheck_fleet", "rank_kill_midtrain", "rank_hang",
+             "elastic_shrink", "lockcheck_gang")
 
 
 def log(msg: str) -> None:
@@ -1173,6 +1197,454 @@ def scenario_corrupt_subproc(tmp: str, trees: int, kill_at: int) -> str:
     return "corrupt checkpoint -> subprocess resume refused loudly"
 
 
+# ------------------------------------------------------- training gang
+def _stub_gang_job(trees: int, work_s: float = 0.01, hang=None,
+                   die=None):
+    """A deterministic stand-in training job for ThreadRank gangs: the
+    per-iteration state is a hash CHAIN over the iteration number only,
+    so every rank (at any world size, resumed from any barrier)
+    computes bitwise-identical state — exactly the property the real
+    redundant-mode train loop has.  ``die``/``hang`` inject the chaos:
+    ``die={"slot": s, "at": k}`` raises in EVERY incarnation of slot s
+    at iteration k (before the barrier checkpoint commits — a
+    crash-looping host); ``hang={"slot": s, "at": k, "fired": False}``
+    stalls once, after the heartbeat, until the supervisor hang-kills.
+    """
+    import hashlib
+
+    from lightgbm_tpu.resilience.atomic import (atomic_write,
+                                                atomic_write_json)
+
+    def job(ctx):
+        ckpt_dir = os.path.join(ctx.slot_dir, "ckpt")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        start, state = 0, "genesis"
+        if ctx.resume:
+            its = sorted(
+                int(f[5:13]) for f in os.listdir(ckpt_dir)
+                if f.startswith("ckpt_") and f.endswith(".json"))
+            if its:
+                with open(os.path.join(
+                        ckpt_dir, "ckpt_%08d.json" % its[-1])) as fh:
+                    rec = json.load(fh)
+                start, state = int(rec["iteration"]), rec["state"]
+        ctx.ready()
+        for it in range(start, trees):
+            ctx.check_signals()
+            time.sleep(work_s)
+            completed = it + 1
+            state = hashlib.sha256(
+                f"{state}:{completed}".encode()).hexdigest()
+            if die and ctx.slot == die["slot"] and completed == die["at"]:
+                raise RuntimeError(
+                    f"injected rank death at iteration {completed}")
+            if completed % ctx.barrier_every == 0:
+                # barrier checkpoint commits BEFORE the heartbeat: a
+                # supervisor-observed heartbeat implies the barrier is
+                # durable (same ordering the real after_iteration has)
+                atomic_write_json(
+                    os.path.join(ckpt_dir, "ckpt_%08d.json" % completed),
+                    {"iteration": completed, "state": state})
+            ctx.heartbeat(completed)
+            if (hang and not hang["fired"] and ctx.slot == hang["slot"]
+                    and completed == hang["at"]):
+                hang["fired"] = True  # single-shot across incarnations
+                while True:  # no heartbeat: the deadline must fire
+                    ctx.check_signals()
+                    time.sleep(0.01)
+        atomic_write(os.path.join(ctx.slot_dir, "model.txt"),
+                     state + "\n")
+
+    return job
+
+
+def _run_stub_gang(gdir, slots, job, barrier_every, chaos_kill_at=None,
+                   **sup_kwargs):
+    """Run a ThreadRank gang of ``job`` under a GangSupervisor tuned
+    for sub-second dryrun chaos; returns (rc, supervisor)."""
+    from lightgbm_tpu.resilience.gang import (GangSupervisor, ThreadRank,
+                                              ThreadRankContext)
+
+    os.makedirs(gdir, exist_ok=True)
+
+    def ckpt_dir_for(s):
+        return os.path.join(gdir, f"r{s}", "ckpt")
+
+    def factory(slot, rank, world, resume):
+        sdir = os.path.join(gdir, f"r{slot}")
+        os.makedirs(ckpt_dir_for(slot), exist_ok=True)
+        ctx = ThreadRankContext(slot, rank, world, gdir, sdir,
+                                barrier_every, resume)
+        return ThreadRank(slot, rank, job, ctx)
+
+    kw = dict(restart_budget=6, rank_fail_limit=2, min_ranks=1,
+              backoff_base_s=0.01, backoff_max_s=0.05,
+              heartbeat_timeout_s=0.5, ready_timeout_s=30.0,
+              poll_interval_s=0.003)
+    kw.update(sup_kwargs)
+    sup = GangSupervisor(factory, slots=list(slots), gang_dir=gdir,
+                         ckpt_dir_for=ckpt_dir_for,
+                         barrier_every=barrier_every,
+                         chaos_kill_at=chaos_kill_at, **kw)
+    rc = sup.run()
+    return rc, sup
+
+
+def _stub_gang_model(gdir: str, slot: int = 0) -> bytes:
+    with open(os.path.join(gdir, f"r{slot}", "model.txt"), "rb") as fh:
+        return fh.read()
+
+
+def scenario_rank_kill_inproc(tmp: str) -> str:
+    """One rank of a 4-rank gang SIGKILLed mid-iteration: rollback to
+    the last common barrier, reform at the same world size, final model
+    bitwise-identical to an uninterrupted gang, recovery attributable
+    (timeline + flight-recorder dump)."""
+    from lightgbm_tpu.obs import flightrec
+
+    trees, every = 12, 3
+    base = os.path.join(tmp, "gang_base")
+    rc, sup = _run_stub_gang(base, [0, 1, 2, 3],
+                             _stub_gang_job(trees), every)
+    assert rc == 0 and sup.recoveries == [], (rc, sup.recoveries)
+    want = _stub_gang_model(base)
+
+    gdir = os.path.join(tmp, "gang_kill")
+    flightrec.set_dump_dir(gdir)
+    rc, sup = _run_stub_gang(gdir, [0, 1, 2, 3], _stub_gang_job(trees),
+                             every, chaos_kill_at={1: 5})
+    assert rc == 0, f"gang rc={rc}: {sup.describe()}"
+    assert sup.rank_deaths >= 1 and sup.restarts >= 1, sup.describe()
+    assert sup.shrinks == 0, "same-world recovery must not shrink"
+    assert sup.recoveries, "no recovery timeline"
+    rec = sup.recoveries[0]
+    assert rec["cause"] == "rank_death" and rec["mttr_s"] > 0, rec
+    got = _stub_gang_model(gdir)
+    assert got == want, (
+        "RECOVERED GANG MODEL DIFFERS from uninterrupted gang — the "
+        "bitwise-identity contract is broken at world size 4")
+    _assert_flightrec_dump(gdir, "gang_recovery", "gang_abort_rank_death")
+    return (f"slot 1 killed at iteration >= 5 -> rollback to barrier "
+            f"{rec['barrier']} -> reform -> bitwise-identical model "
+            f"(mttr {rec['mttr_s']:.3f}s, {rec['lost_iterations']} "
+            "lost iteration(s) re-trained, 0 failed)")
+
+
+def scenario_rank_hang_inproc(tmp: str) -> str:
+    """One rank stalls WITHOUT heartbeating: the heartbeat deadline
+    declares it hung, the supervisor kills it, and rollback/reform
+    restores a bitwise-identical final model."""
+    from lightgbm_tpu.obs import flightrec
+
+    trees, every = 12, 3
+    base = os.path.join(tmp, "hang_base")
+    rc, _ = _run_stub_gang(base, [0, 1, 2], _stub_gang_job(trees), every)
+    assert rc == 0
+    want = _stub_gang_model(base)
+
+    gdir = os.path.join(tmp, "hang_gang")
+    flightrec.set_dump_dir(gdir)
+    hang = {"slot": 2, "at": 6, "fired": False}
+    rc, sup = _run_stub_gang(gdir, [0, 1, 2],
+                             _stub_gang_job(trees, hang=hang), every)
+    assert rc == 0, f"gang rc={rc}: {sup.describe()}"
+    assert sup.rank_hangs == 1, sup.describe()
+    rec = sup.recoveries[0]
+    assert rec["cause"] == "rank_hang", rec
+    # the hang fired AFTER heartbeat 6 committed barrier 6, so the
+    # rollback must not regress past it
+    assert rec["barrier"] == 6, rec
+    assert _stub_gang_model(gdir) == want, (
+        "POST-HANG MODEL DIFFERS from uninterrupted gang")
+    _assert_flightrec_dump(gdir, "gang_recovery", "gang_abort_rank_hang")
+    return (f"slot 2 stalled at iteration 6 -> heartbeat deadline fired "
+            f"-> hang-kill -> resume from barrier {rec['barrier']} -> "
+            f"bitwise-identical model (mttr {rec['mttr_s']:.3f}s)")
+
+
+def scenario_elastic_shrink_inproc(tmp: str) -> str:
+    """A slot that dies EVERY incarnation exhausts its
+    rank_fail_limit; the ladder's third rung shrinks the gang past it,
+    survivors resume from the barrier (redundant mode -> bitwise), and
+    the reshard parity gate provably distinguishes a tampered shard."""
+    from lightgbm_tpu.obs import flightrec
+    from lightgbm_tpu.resilience.gang import (histogram_fingerprint,
+                                              shard_rows)
+
+    trees, every = 10, 2
+    base = os.path.join(tmp, "shrink_base")
+    rc, _ = _run_stub_gang(base, [0, 1, 2, 3], _stub_gang_job(trees),
+                           every)
+    assert rc == 0
+    want = _stub_gang_model(base)
+
+    gdir = os.path.join(tmp, "shrink_gang")
+    flightrec.set_dump_dir(gdir)
+    die = {"slot": 3, "at": 4}
+    rc, sup = _run_stub_gang(gdir, [0, 1, 2, 3],
+                             _stub_gang_job(trees, die=die), every)
+    assert rc == 0, f"gang rc={rc}: {sup.describe()}"
+    assert sup.shrinks == 1 and sup.restarts >= 1, sup.describe()
+    assert sup.active_slot_ids() == [0, 1, 2], sup.active_slot_ids()
+    actions = [r["action"] for r in sup.recoveries]
+    assert actions[-1] == "shrink" and "restart" in actions, actions
+    assert _stub_gang_model(gdir) == want, (
+        "POST-SHRINK MODEL DIFFERS (redundant-mode survivors must "
+        "resume bitwise)")
+    _assert_flightrec_dump(gdir, "gang_recovery", "gang_abort_rank_death")
+
+    # the parity gate: any round-robin partition carries the source row
+    # multiset; a tampered shard provably does not
+    src = os.path.join(tmp, "shrink_data.csv")
+    make_data(src, 101, seed=12)
+    want_fp = histogram_fingerprint([src])
+    p4 = shard_rows(src, os.path.join(gdir, "s4"), [0, 1, 2, 3])
+    p3 = shard_rows(src, os.path.join(gdir, "s3"), [0, 1, 2])
+    assert histogram_fingerprint(list(p4.values())) == want_fp
+    assert histogram_fingerprint(list(p3.values())) == want_fp
+    with open(p3[1]) as fh:
+        lines = fh.read().splitlines()
+    with open(p3[1], "w") as fh:  # drop one row: multiset changes
+        fh.write("\n".join(lines[1:]) + "\n")
+    assert histogram_fingerprint(list(p3.values())) != want_fp, (
+        "parity gate failed to detect a lost row")
+    return ("slot 3 died twice -> restart, then shrink 4->3 -> "
+            "survivors resumed bitwise; reshard parity gate holds for "
+            "4-way and 3-way shards and rejects a tampered shard")
+
+
+_LOCKCHECK_GANG_DRIVER = r"""
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.getcwd())
+
+from lightgbm_tpu.analysis import lockcheck
+
+assert lockcheck.enabled(), "LGBM_TPU_LOCKCHECK=1 did not take"
+
+from lightgbm_tpu.resilience.atomic import atomic_write, atomic_write_json
+from lightgbm_tpu.resilience.gang import (GangSupervisor, ThreadRank,
+                                          ThreadRankContext)
+
+gdir = sys.argv[1]
+trees, every = 8, 2
+
+
+def job(ctx):
+    ckpt_dir = os.path.join(ctx.slot_dir, "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    start, state = 0, "genesis"
+    if ctx.resume:
+        its = sorted(int(f[5:13]) for f in os.listdir(ckpt_dir)
+                     if f.startswith("ckpt_") and f.endswith(".json"))
+        if its:
+            with open(os.path.join(ckpt_dir,
+                                   "ckpt_%08d.json" % its[-1])) as fh:
+                rec = json.load(fh)
+            start, state = int(rec["iteration"]), rec["state"]
+    ctx.ready()
+    for it in range(start, trees):
+        ctx.check_signals()
+        time.sleep(0.004)
+        done = it + 1
+        state = hashlib.sha256(("%s:%d" % (state, done)).encode()) \
+            .hexdigest()
+        if done % every == 0:
+            atomic_write_json(
+                os.path.join(ckpt_dir, "ckpt_%08d.json" % done),
+                {"iteration": done, "state": state})
+        ctx.heartbeat(done)
+    atomic_write(os.path.join(ctx.slot_dir, "model.txt"), state + "\n")
+
+
+def factory(slot, rank, world, resume):
+    sdir = os.path.join(gdir, "r%d" % slot)
+    os.makedirs(os.path.join(sdir, "ckpt"), exist_ok=True)
+    ctx = ThreadRankContext(slot, rank, world, gdir, sdir, every, resume)
+    return ThreadRank(slot, rank, job, ctx)
+
+
+sup = GangSupervisor(
+    factory, slots=[0, 1, 2], gang_dir=gdir,
+    ckpt_dir_for=lambda s: os.path.join(gdir, "r%d" % s, "ckpt"),
+    barrier_every=every, restart_budget=4, rank_fail_limit=2,
+    backoff_base_s=0.01, backoff_max_s=0.02, heartbeat_timeout_s=5.0,
+    ready_timeout_s=30.0, poll_interval_s=0.003, chaos_kill_at={1: 3})
+rc = sup.run()
+
+print(json.dumps({
+    "rc": rc,
+    "restarts": sup.restarts,
+    "rank_deaths": sup.rank_deaths,
+    "findings": lockcheck.findings(),
+    "acquisitions": {k: v["acquisitions"]
+                     for k, v in lockcheck.stats().items()},
+}))
+"""
+
+
+def scenario_lockcheck_gang(tmp: str) -> str:
+    """The gang supervisor under the runtime lock sanitizer
+    (LGBM_TPU_LOCKCHECK=1 in a fresh process): a full
+    kill-recover-finish cycle must produce ZERO findings while the
+    instrumented gang.state lock demonstrably saw traffic."""
+    gdir = os.path.join(tmp, "lockgang")
+    os.makedirs(gdir, exist_ok=True)
+    driver = os.path.join(tmp, "lockcheck_gang_driver.py")
+    with open(driver, "w", encoding="utf-8") as fh:
+        fh.write(_LOCKCHECK_GANG_DRIVER)
+    r = subprocess.run(
+        [sys.executable, driver, gdir],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "LGBM_TPU_LOCKCHECK": "1",
+             "LGBM_TPU_FLIGHTREC_DIR": gdir},
+    )
+    assert r.returncode == 0, (
+        f"driver rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["rc"] == 0, out
+    assert out["rank_deaths"] >= 1 and out["restarts"] >= 1, out
+    assert out["findings"] == [], (
+        "sanitizer findings under gang recovery: "
+        + json.dumps(out["findings"])[:2000])
+    acq = out["acquisitions"]
+    assert acq.get("gang.state", 0) > 0, acq
+    return (f"gang under LGBM_TPU_LOCKCHECK=1: kill -> recover -> "
+            f"finish with {acq['gang.state']} gang.state acquisitions, "
+            "zero sanitizer findings")
+
+
+def _fleet_train_args(data, model, trees, ranks, gdir, extra=()):
+    """task=train_fleet argv sharing the exact training params
+    ``train_args`` uses, so the gang's final model is comparable
+    bitwise against a plain single-process run."""
+    args = train_args(data, model, trees, extra)
+    return ["task=train_fleet" if a == "task=train" else a
+            for a in args] + [
+        f"train_ranks={ranks}", "snapshot_freq=2", f"gang_dir={gdir}",
+        "gang_backoff_base_s=0.05", "gang_backoff_max_s=0.2"]
+
+
+def scenario_rank_kill_subproc(tmp: str, trees: int) -> str:
+    """ISSUE 20 acceptance: a REAL 4-rank ``task=train_fleet`` run with
+    one rank SIGKILLed mid-train recovers to a final model BITWISE
+    identical to an uninterrupted plain train, commits a
+    train-fleet/v1 artifact with a recovery timeline, and that
+    artifact passes the benchdiff MTTR gate."""
+    data = os.path.join(tmp, "gd.csv")
+    make_data(data, 400)
+    m_a = os.path.join(tmp, "gang_uninterrupted.txt")
+    rc, out = _run_train(train_args(data, m_a, trees))
+    assert rc == 0, f"uninterrupted train rc={rc}:\n{out[-1500:]}"
+
+    m_b = os.path.join(tmp, "gang_recovered.txt")
+    gdir = os.path.join(tmp, "gang")
+    rc, out = _run_train(
+        _fleet_train_args(data, m_b, trees, 4, gdir),
+        env_extra={"LGBM_TPU_GANG_CHAOS_KILL": "1:3"})
+    assert rc == 0, f"train_fleet rc={rc}:\n{out[-3000:]}"
+    a, b = open(m_a, "rb").read(), open(m_b, "rb").read()
+    assert a == b, (
+        "GANG MODEL DIFFERS from uninterrupted run after rank kill "
+        f"({len(a)} vs {len(b)} bytes) — bitwise contract broken")
+
+    art = os.path.join(gdir, "train_fleet.json")
+    with open(art) as fh:
+        doc = json.load(fh)
+    tf = doc["train_fleet"]
+    assert tf["failed_iterations"] == 0, tf
+    assert tf["recoveries"] >= 1 and tf["mttr_s"] > 0, tf
+    assert tf["world_size_end"] == 4, tf
+    assert doc["counters"].get("lgbm_gang_chaos_kills", 0) >= 1, doc
+
+    # the benchdiff MTTR gate: self-compare must pass outright; if a
+    # committed baseline exists, the fresh run must pass against it
+    bd = [sys.executable, os.path.join(ROOT, "tools", "benchdiff.py")]
+    r = subprocess.run([*bd, art, art], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, f"benchdiff self-compare:\n{r.stdout}"
+    committed = os.path.join(ROOT, ".bench", "train_fleet.json")
+    gate = "self-compare"
+    if os.path.exists(committed):
+        r = subprocess.run(
+            [*bd, committed, art, "--phase-threshold", "100"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, (
+            f"benchdiff MTTR gate vs committed baseline:\n{r.stdout}")
+        gate = "vs committed .bench/train_fleet.json"
+    return (f"rank 1 SIGKILLed at iteration 3 of a 4-rank fleet -> "
+            f"{tf['recoveries']} recovery(ies), mttr {tf['mttr_s']:.2f}s, "
+            f"0 failed iterations, bitwise-identical model; benchdiff "
+            f"gate passed ({gate})")
+
+
+def scenario_rank_hang_subproc(tmp: str) -> str:
+    """A real rank subprocess stalls via the ``hang_after_tree`` fault
+    (heartbeats stop, process lives): the supervisor's deadline fires,
+    the rank is hang-killed, and the gang recovers bitwise."""
+    trees = 6
+    data = os.path.join(tmp, "hd.csv")
+    make_data(data, 300, seed=11)
+    m_a = os.path.join(tmp, "hang_uninterrupted.txt")
+    rc, out = _run_train(train_args(data, m_a, trees))
+    assert rc == 0, f"uninterrupted train rc={rc}:\n{out[-1500:]}"
+
+    m_b = os.path.join(tmp, "hang_recovered.txt")
+    gdir = os.path.join(tmp, "hang_gang")
+    # hang at iteration 4 (a barrier): the stalled rank's barrier-4
+    # checkpoint commits before the stall and survives _KEEP pruning,
+    # so the gang resumes from 4, not from scratch
+    rc, out = _run_train(
+        _fleet_train_args(data, m_b, trees, 3, gdir,
+                          ["gang_heartbeat_timeout_s=30"]),
+        env_extra={"LGBM_TPU_GANG_FAULT": "2:hang_after_tree:4:600"})
+    assert rc == 0, f"train_fleet rc={rc}:\n{out[-3000:]}"
+    assert open(m_a, "rb").read() == open(m_b, "rb").read(), (
+        "POST-HANG GANG MODEL DIFFERS from uninterrupted run")
+    with open(os.path.join(gdir, "train_fleet.json")) as fh:
+        tf = json.load(fh)["train_fleet"]
+    assert tf["rank_hangs"] >= 1, tf
+    assert tf["failed_iterations"] == 0, tf
+    return (f"rank 2 stalled at iteration 4 -> heartbeat deadline -> "
+            f"hang-kill -> recover (mttr {tf['mttr_s']:.2f}s) -> "
+            "bitwise-identical model")
+
+
+def scenario_elastic_shrink_subproc(tmp: str) -> str:
+    """A persistently dying slot (``always`` chaos kill, re-armed at
+    every formation) drives the ladder to its shrink rung in a real
+    subprocess fleet: world 4 -> 3, survivors resume from the barrier,
+    final model still bitwise-identical (redundant mode)."""
+    trees = 8
+    data = os.path.join(tmp, "sd.csv")
+    make_data(data, 300, seed=13)
+    m_a = os.path.join(tmp, "shrink_uninterrupted.txt")
+    rc, out = _run_train(train_args(data, m_a, trees))
+    assert rc == 0, f"uninterrupted train rc={rc}:\n{out[-1500:]}"
+
+    m_b = os.path.join(tmp, "shrink_recovered.txt")
+    gdir = os.path.join(tmp, "shrink_gang")
+    rc, out = _run_train(
+        _fleet_train_args(data, m_b, trees, 4, gdir),
+        env_extra={"LGBM_TPU_GANG_CHAOS_KILL": "3:2:always"})
+    assert rc == 0, f"train_fleet rc={rc}:\n{out[-3000:]}"
+    assert open(m_a, "rb").read() == open(m_b, "rb").read(), (
+        "POST-SHRINK GANG MODEL DIFFERS from uninterrupted run")
+    with open(os.path.join(gdir, "train_fleet.json")) as fh:
+        tf = json.load(fh)["train_fleet"]
+    assert tf["shrinks"] == 1, tf
+    assert tf["world_size_end"] == 3, tf
+    assert tf["failed_iterations"] == 0, tf
+    return (f"slot 3 crash-looped -> restart, then shrink 4->3 "
+            f"(mttr {tf['mttr_s']:.2f}s) -> survivors finished a "
+            "bitwise-identical model")
+
+
 # ------------------------------------------------------------------ main
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -1236,6 +1708,13 @@ def main() -> int:
         run("serve_drain", scenario_serve_drain_inproc, tmp, 3)
         run("replica_kill", scenario_replica_kill_inproc, tmp, 3)
         run("lockcheck_fleet", scenario_lockcheck_fleet, tmp, 3)
+        # training-gang scenarios (ISSUE 20): ThreadRank gangs running
+        # a deterministic stub job — same supervisor, barrier math, and
+        # recovery ladder the real task=train_fleet path uses
+        run("rank_kill_midtrain", scenario_rank_kill_inproc, tmp)
+        run("rank_hang", scenario_rank_hang_inproc, tmp)
+        run("elastic_shrink", scenario_elastic_shrink_inproc, tmp)
+        run("lockcheck_gang", scenario_lockcheck_gang, tmp)
     else:
         run("kill_resume", scenario_kill_resume_subproc, tmp, args.trees,
             args.seed)
@@ -1266,6 +1745,13 @@ def main() -> int:
         run("serve_drain", scenario_serve_drain_subproc, tmp, 3)
         run("replica_kill", scenario_replica_kill_subproc, tmp, 3)
         run("lockcheck_fleet", scenario_lockcheck_fleet, tmp, 3)
+        # training-gang scenarios, the real thing: a 4-rank
+        # task=train_fleet with real rank subprocesses — the
+        # rank_kill_midtrain pass is the ISSUE 20 acceptance run
+        run("rank_kill_midtrain", scenario_rank_kill_subproc, tmp, 12)
+        run("rank_hang", scenario_rank_hang_subproc, tmp)
+        run("elastic_shrink", scenario_elastic_shrink_subproc, tmp)
+        run("lockcheck_gang", scenario_lockcheck_gang, tmp)
 
     summary = {"mode": "dryrun" if args.dryrun else "subprocess",
                "seed": args.seed, "failures": failures,
